@@ -1,0 +1,194 @@
+"""ray_tpu: a TPU-native distributed execution and ML training framework.
+
+Capability parity with the Ray 0.9 reference (tasks, actors, distributed
+object store, cluster scheduling, RL/tuning/data-parallel training
+libraries), re-architected TPU-first: JAX/XLA for all device compute, XLA
+collectives over ICI for gradient exchange, and a direct-call host runtime.
+
+Public surface (parity: `python/ray/__init__.py` + `worker.py`):
+
+    import ray_tpu
+
+    ray_tpu.init()
+
+    @ray_tpu.remote
+    def f(x): return x * 2
+
+    ray_tpu.get(f.remote(2))  # -> 4
+
+    @ray_tpu.remote
+    class Counter:
+        def __init__(self): self.n = 0
+        def inc(self): self.n += 1; return self.n
+
+    c = Counter.remote()
+    ray_tpu.get(c.inc.remote())  # -> 1
+"""
+
+from __future__ import annotations
+
+import inspect as _inspect
+from typing import Optional as _Optional
+
+from . import exceptions
+from ._private import node as _node
+from ._private import worker_state as _ws
+from ._private.object_ref import ObjectRef
+from ._private.ids import ActorID, JobID, ObjectID, TaskID
+from .actor import ActorClass, ActorHandle, exit_actor, get_actor, method
+from .remote_function import RemoteFunction
+from .exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
+                         RayActorError, RayError, RayTaskError, TaskError,
+                         WorkerCrashedError)
+
+__version__ = "0.1.0"
+
+_LOCAL_RUNTIME = None
+
+
+def init(num_cpus: _Optional[float] = None,
+         num_tpus: _Optional[float] = None,
+         resources: _Optional[dict] = None,
+         local_mode: bool = False,
+         num_initial_workers: int = 0,
+         worker_env: _Optional[dict] = None):
+    """Start the runtime (parity: `ray.init`, `python/ray/worker.py:525`).
+
+    In a worker process this is a no-op (the worker is already connected).
+    """
+    global _LOCAL_RUNTIME
+    if _ws.mode() == _ws.WORKER_MODE:
+        return None
+    if _ws.get_runtime_or_none() is not None:
+        raise RuntimeError("ray_tpu.init() called twice; call "
+                           "ray_tpu.shutdown() first")
+    if local_mode:
+        from ._private.local_mode import LocalRuntime
+        _LOCAL_RUNTIME = LocalRuntime()
+        _ws.set_runtime(_LOCAL_RUNTIME, _ws.LOCAL_MODE)
+        return _LOCAL_RUNTIME
+    return _node.init(resources=resources, num_cpus=num_cpus,
+                      num_tpus=num_tpus,
+                      num_initial_workers=num_initial_workers,
+                      worker_env=worker_env)
+
+
+def shutdown():
+    """Stop the runtime and clean up the session (parity: `ray.shutdown`)."""
+    global _LOCAL_RUNTIME
+    if _LOCAL_RUNTIME is not None:
+        _LOCAL_RUNTIME.shutdown()
+        _LOCAL_RUNTIME = None
+        _ws.clear()
+        return
+    _node.shutdown()
+
+
+def is_initialized() -> bool:
+    return _ws.get_runtime_or_none() is not None
+
+
+def put(value) -> ObjectRef:
+    """Store a value in the object store (parity: `ray.put`,
+    `worker.py:1505`)."""
+    return _ws.get_runtime().put(value)
+
+
+def get(refs, timeout: _Optional[float] = None):
+    """Fetch object values, blocking until available (parity: `ray.get`,
+    `worker.py:1440`). Accepts one ref or a list."""
+    if isinstance(refs, list):
+        bad = [r for r in refs if not isinstance(r, ObjectRef)]
+        if bad:
+            raise TypeError(f"ray_tpu.get expects ObjectRefs, got {type(bad[0])}")
+    elif not isinstance(refs, ObjectRef):
+        raise TypeError(f"ray_tpu.get expects an ObjectRef or a list of them, "
+                        f"got {type(refs)}")
+    return _ws.get_runtime().get(refs, timeout=timeout)
+
+
+def wait(refs, num_returns: int = 1, timeout: _Optional[float] = None):
+    """Return (ready, not_ready) (parity: `ray.wait`, `worker.py:1540`)."""
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    return _ws.get_runtime().wait(refs, num_returns=num_returns,
+                                  timeout=timeout)
+
+
+def kill(actor: ActorHandle, no_restart: bool = True):
+    """Forcefully terminate an actor (parity: `ray.kill`)."""
+    _ws.get_runtime().kill_actor(actor._actor_id, no_restart=no_restart)
+
+
+def free(refs):
+    """Release object values from the store (explicit eviction; parity:
+    `ray.experimental.free`)."""
+    if isinstance(refs, ObjectRef):
+        refs = [refs]
+    _ws.get_runtime().free(refs)
+
+
+def remote(*args, **kwargs):
+    """The `@ray_tpu.remote` decorator for functions and classes (parity:
+    `ray.remote`, `worker.py:1697`).
+
+    Supported options: num_returns, num_cpus, num_tpus, resources,
+    max_retries (functions); num_cpus, num_tpus, resources, max_restarts,
+    max_concurrency (classes).
+    """
+    _FN_OPTS = {"num_returns", "num_cpus", "num_tpus", "resources",
+                "max_retries"}
+    _CLS_OPTS = {"num_cpus", "num_tpus", "resources", "max_restarts",
+                 "max_concurrency"}
+
+    def make(target):
+        allowed = _CLS_OPTS if _inspect.isclass(target) else _FN_OPTS
+        unknown = set(kwargs) - allowed
+        if unknown:
+            kind = "class" if _inspect.isclass(target) else "function"
+            raise TypeError(
+                f"unknown @ray_tpu.remote option(s) for a {kind}: "
+                f"{sorted(unknown)}; allowed: {sorted(allowed)}")
+        if _inspect.isclass(target):
+            return ActorClass(
+                target,
+                num_cpus=kwargs.get("num_cpus"),
+                num_tpus=kwargs.get("num_tpus"),
+                resources=kwargs.get("resources"),
+                max_restarts=kwargs.get("max_restarts", 0),
+                max_concurrency=kwargs.get("max_concurrency"))
+        return RemoteFunction(
+            target,
+            num_returns=kwargs.get("num_returns", 1),
+            num_cpus=kwargs.get("num_cpus"),
+            num_tpus=kwargs.get("num_tpus"),
+            resources=kwargs.get("resources"),
+            max_retries=kwargs.get("max_retries", 3))
+
+    if len(args) == 1 and callable(args[0]) and not kwargs:
+        return make(args[0])
+    if args:
+        raise TypeError("@ray_tpu.remote takes keyword options only")
+    return make
+
+
+def cluster_resources() -> dict:
+    return _ws.get_runtime().cluster_info()["total_resources"]
+
+
+def available_resources() -> dict:
+    return _ws.get_runtime().cluster_info()["available_resources"]
+
+
+def cluster_info() -> dict:
+    return _ws.get_runtime().cluster_info()
+
+
+__all__ = [
+    "ActorClass", "ActorDiedError", "ActorHandle", "GetTimeoutError",
+    "ObjectLostError", "ObjectRef", "RayActorError", "RayError",
+    "RayTaskError", "TaskError", "WorkerCrashedError", "available_resources",
+    "cluster_info", "cluster_resources", "exceptions", "exit_actor", "free",
+    "get", "get_actor", "init", "is_initialized", "kill", "method", "put",
+    "remote", "shutdown", "wait",
+]

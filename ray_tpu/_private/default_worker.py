@@ -1,0 +1,37 @@
+"""Worker process entry point.
+
+Parity: `python/ray/workers/default_worker.py` in the reference — connect to
+the head, then block in the task-execution loop.
+"""
+
+import argparse
+import logging
+import os
+import sys
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head-sock", required=True)
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--session-name", required=True)
+    args = parser.parse_args()
+
+    logging.basicConfig(
+        level=os.environ.get("RAY_TPU_LOG_LEVEL", "WARNING"),
+        format=f"[worker {os.getpid()}] %(levelname)s %(name)s: %(message)s")
+
+    # Make the repo importable the same way the driver sees it.
+    sys.path.insert(0, os.getcwd())
+
+    from ray_tpu._private.runtime import Runtime
+    from ray_tpu._private import worker_state
+
+    rt = Runtime(args.session_dir, args.session_name, args.head_sock,
+                 role="worker")
+    worker_state.set_runtime(rt, mode=worker_state.WORKER_MODE)
+    rt.run_worker_loop()
+
+
+if __name__ == "__main__":
+    main()

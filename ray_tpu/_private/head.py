@@ -1,0 +1,554 @@
+"""Head server: cluster metadata + lease-based scheduler + process supervisor.
+
+Parity: this one component plays the roles of the reference's GCS
+(`src/ray/gcs/gcs_server/` — metadata tables, pub/sub, actor directory, KV),
+the raylet NodeManager (`src/ray/raylet/node_manager.h` — resource
+accounting, worker leases, dispatch), the WorkerPool
+(`src/ray/raylet/worker_pool.h` — spawning/registering worker processes) and
+the raylet monitor (`src/ray/raylet/monitor.cc` — death detection). It runs
+as threads inside the driver process (like `ray.init()`'s head node) and
+speaks the protocol in `protocol.py`.
+
+Scheduling follows the reference's *direct call* generation only
+(`direct_task_transport.h`): the head grants a worker lease per task and the
+data plane (args/results) flows directly between workers; the head never
+touches object payloads.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Set
+
+from ..exceptions import ActorDiedError, WorkerCrashedError
+from .ids import ActorID, TaskID
+from .task_spec import ACTOR_CREATION_TASK, TaskSpec
+from . import protocol
+
+logger = logging.getLogger(__name__)
+
+# Actor states (reference: ActorTableData states, src/ray/gcs/tables.h:710).
+PENDING, ALIVE, RESTARTING, DEAD = "PENDING", "ALIVE", "RESTARTING", "DEAD"
+
+
+class ActorInfo:
+    def __init__(self, spec: TaskSpec):
+        self.spec = spec
+        self.state = PENDING
+        self.addr: Optional[str] = None
+        self.worker_pid: Optional[int] = None
+        self.restarts_left = spec.max_restarts
+        self.death_reason: str = ""
+
+    def view(self) -> dict:
+        return {
+            "actor_id": self.spec.actor_id,
+            "state": self.state,
+            "addr": self.addr,
+            "name": self.spec.name,
+            "death_reason": self.death_reason,
+            "restarts_left": self.restarts_left,
+        }
+
+
+class WorkerInfo:
+    def __init__(self, proc: subprocess.Popen):
+        self.proc = proc
+        self.addr: Optional[str] = None
+        self.conn: Optional[protocol.Connection] = None
+        self.registered = threading.Event()
+        self.current_task: Optional[TaskSpec] = None
+        self.actor_id: Optional[ActorID] = None  # dedicated actor worker
+        self.dedicated = False
+        self.started_at = time.monotonic()
+
+
+class HeadServer:
+    def __init__(self, session_dir: str, session_name: str,
+                 resources: Dict[str, float], worker_env: Optional[dict] = None):
+        self.session_dir = session_dir
+        self.session_name = session_name
+        self.sock_path = os.path.join(session_dir, "head.sock")
+        self.total_resources = dict(resources)
+        self.available = dict(resources)
+        self.worker_env = worker_env or {}
+
+        self._lock = threading.RLock()
+        self._kv: Dict[str, bytes] = {}
+        self._subs: Dict[str, Set[protocol.Connection]] = {}
+        self._workers: Dict[str, WorkerInfo] = {}  # by addr once registered
+        self._spawned: List[WorkerInfo] = []  # registered or not
+        self._idle: deque = deque()  # addrs of idle pool workers
+        self._pending: deque = deque()  # TaskSpec queue
+        self._inflight: Dict[TaskID, str] = {}  # task -> worker addr
+        self._actors: Dict[ActorID, ActorInfo] = {}
+        self._drivers: Set[protocol.Connection] = set()
+        self._conns_by_addr: Dict[str, protocol.Connection] = {}
+        self._shutdown = False
+        # Number of pool workers being spawned that haven't registered yet.
+        self._spawning_pool = 0
+
+        self.server = protocol.Server(
+            self.sock_path, self._handle, on_connect=self._on_connect,
+            on_close=self._on_conn_close)
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="head-monitor")
+        self._monitor_thread.start()
+
+    # ------------------------------------------------------------------
+    # connection lifecycle
+    # ------------------------------------------------------------------
+    def _on_connect(self, conn: protocol.Connection, hello: dict):
+        role = hello.get("role")
+        with self._lock:
+            self._conns_by_addr[conn.peer_addr] = conn
+            if role == "driver":
+                self._drivers.add(conn)
+            elif role == "worker":
+                pid = hello.get("pid")
+                for w in self._spawned:
+                    if w.proc.pid == pid and w.addr is None:
+                        w.addr = conn.peer_addr
+                        w.conn = conn
+                        self._workers[conn.peer_addr] = w
+                        if not w.dedicated:
+                            self._spawning_pool -= 1
+                            self._idle.append(conn.peer_addr)
+                        w.registered.set()
+                        break
+                else:
+                    logger.warning("unknown worker registered pid=%s", pid)
+            self._schedule_locked()
+
+    def _on_conn_close(self, conn: protocol.Connection):
+        with self._lock:
+            self._conns_by_addr.pop(conn.peer_addr, None)
+            self._drivers.discard(conn)
+            for subs in self._subs.values():
+                subs.discard(conn)
+
+    # ------------------------------------------------------------------
+    # message handling
+    # ------------------------------------------------------------------
+    def _handle(self, conn: protocol.Connection, msg: dict):
+        kind = msg["kind"]
+        fn = getattr(self, "_h_" + kind, None)
+        if fn is None:
+            logger.warning("head: unknown message %s", kind)
+            return
+        fn(conn, msg)
+
+    # -- kv / pubsub -----------------------------------------------------
+    def _h_kv_put(self, conn, msg):
+        with self._lock:
+            exists = msg["key"] in self._kv
+            if not (msg.get("overwrite", True) is False and exists):
+                self._kv[msg["key"]] = msg["value"]
+        if "seq" in msg:
+            conn.reply(msg, ok=not exists or msg.get("overwrite", True))
+
+    def _h_kv_get(self, conn, msg):
+        with self._lock:
+            val = self._kv.get(msg["key"])
+        conn.reply(msg, value=val)
+
+    def _h_kv_del(self, conn, msg):
+        with self._lock:
+            self._kv.pop(msg["key"], None)
+        if "seq" in msg:
+            conn.reply(msg, ok=True)
+
+    def _h_kv_keys(self, conn, msg):
+        prefix = msg.get("prefix", "")
+        with self._lock:
+            keys = [k for k in self._kv if k.startswith(prefix)]
+        conn.reply(msg, keys=keys)
+
+    def _h_subscribe(self, conn, msg):
+        with self._lock:
+            self._subs.setdefault(msg["channel"], set()).add(conn)
+        if "seq" in msg:
+            conn.reply(msg, ok=True)
+
+    def _h_publish(self, conn, msg):
+        self._publish(msg["channel"], msg["data"])
+
+    def _publish(self, channel: str, data):
+        with self._lock:
+            subs = list(self._subs.get(channel, ()))
+        for c in subs:
+            try:
+                c.send({"kind": "publish", "channel": channel, "data": data})
+            except protocol.ConnectionClosed:
+                pass
+
+    # -- tasks -----------------------------------------------------------
+    def _h_submit_task(self, conn, msg):
+        spec: TaskSpec = msg["spec"]
+        with self._lock:
+            self._pending.append(spec)
+            self._schedule_locked()
+
+    def _h_task_done(self, conn, msg):
+        task_id: TaskID = msg["task_id"]
+        with self._lock:
+            addr = self._inflight.pop(task_id, None)
+            if addr is None:
+                return
+            w = self._workers.get(addr)
+            if w is not None and w.current_task is not None \
+                    and w.current_task.task_id == task_id:
+                self._release_resources(w.current_task.resources)
+                w.current_task = None
+                if not w.dedicated:
+                    self._idle.append(addr)
+            self._schedule_locked()
+
+    # -- actors ----------------------------------------------------------
+    def _h_create_actor(self, conn, msg):
+        spec: TaskSpec = msg["spec"]
+        with self._lock:
+            info = ActorInfo(spec)
+            self._actors[spec.actor_id] = info
+            if spec.name:
+                key = "named_actor:" + spec.name
+                if key in self._kv:
+                    conn.reply(msg, error=ValueError(
+                        f"actor name {spec.name!r} already taken"))
+                    return
+                self._kv[key] = spec.actor_id.binary()
+            self._pending.append(spec)
+            self._schedule_locked()
+        conn.reply(msg, ok=True)
+
+    def _h_actor_ready(self, conn, msg):
+        actor_id: ActorID = msg["actor_id"]
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return
+            info.state = ALIVE
+            info.addr = msg["addr"]
+            self._inflight.pop(info.spec.task_id, None)
+            # Creation lease resources are released; the actor holds its
+            # declared (usually zero) lifetime resources.
+            self._release_resources(info.spec.resources)
+            view = info.view()
+        self._publish("actor:" + actor_id.hex(), view)
+
+    def _h_actor_creation_failed(self, conn, msg):
+        actor_id: ActorID = msg["actor_id"]
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None:
+                return
+            info.state = DEAD
+            info.death_reason = f"creation failed: {msg.get('error')}"
+            self._inflight.pop(info.spec.task_id, None)
+            self._release_resources(info.spec.resources)
+            self._release_actor_name_locked(info)
+            view = info.view()
+        self._publish("actor:" + actor_id.hex(), view)
+
+    def _h_resolve_actor(self, conn, msg):
+        actor_id: ActorID = msg["actor_id"]
+        with self._lock:
+            info = self._actors.get(actor_id)
+            # Auto-subscribe the caller to updates.
+            self._subs.setdefault("actor:" + actor_id.hex(), set()).add(conn)
+            view = info.view() if info else None
+        conn.reply(msg, info=view)
+
+    def _h_get_named_actor(self, conn, msg):
+        with self._lock:
+            raw = self._kv.get("named_actor:" + msg["name"])
+            info = self._actors.get(ActorID(raw)) if raw else None
+            view = info.view() if info else None
+        conn.reply(msg, info=view)
+
+    def _h_kill_actor(self, conn, msg):
+        actor_id: ActorID = msg["actor_id"]
+        no_restart = msg.get("no_restart", True)
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None or info.state == DEAD:
+                if "seq" in msg:
+                    conn.reply(msg, ok=True)
+                return
+            if no_restart:
+                info.restarts_left = 0
+            w = self._workers.get(info.addr) if info.addr else None
+        if w is not None:
+            try:
+                w.proc.kill()
+            except OSError:
+                pass
+        if "seq" in msg:
+            conn.reply(msg, ok=True)
+
+    # -- introspection ---------------------------------------------------
+    def _h_cluster_info(self, conn, msg):
+        with self._lock:
+            info = {
+                "total_resources": dict(self.total_resources),
+                "available_resources": dict(self.available),
+                "num_workers": len(self._workers),
+                "num_pending_tasks": len(self._pending),
+                "actors": {a.hex(): i.view() for a, i in self._actors.items()},
+                "session_name": self.session_name,
+                "session_dir": self.session_dir,
+            }
+        conn.reply(msg, info=info)
+
+    def _h_report_error(self, conn, msg):
+        self._publish("error", msg["data"])
+
+    # ------------------------------------------------------------------
+    # scheduling (lease grant) — runs under self._lock
+    # ------------------------------------------------------------------
+    def _fits(self, resources: Dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) + 1e-9 >= v
+                   for k, v in resources.items())
+
+    def _acquire_resources(self, resources: Dict[str, float]):
+        for k, v in resources.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+
+    def _release_resources(self, resources: Dict[str, float]):
+        for k, v in resources.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+
+    def _schedule_locked(self):
+        if self._shutdown:
+            return
+        remaining = deque()
+        need_worker = 0
+        while self._pending:
+            spec = self._pending.popleft()
+            if not self._fits(spec.resources):
+                remaining.append(spec)
+                continue
+            if spec.kind == ACTOR_CREATION_TASK:
+                info = self._actors.get(spec.actor_id)
+                if info is None:
+                    continue
+                w = self._spawn_worker(dedicated=True)
+                w.actor_id = spec.actor_id
+                w.current_task = spec
+                info.worker_pid = w.proc.pid
+                self._acquire_resources(spec.resources)
+                self._inflight[spec.task_id] = f"pid:{w.proc.pid}"
+                threading.Thread(
+                    target=self._dispatch_when_registered, args=(w, spec),
+                    daemon=True).start()
+            else:
+                if self._idle:
+                    addr = self._idle.popleft()
+                    w = self._workers[addr]
+                    w.current_task = spec
+                    self._acquire_resources(spec.resources)
+                    self._inflight[spec.task_id] = addr
+                    try:
+                        w.conn.send({"kind": "execute_task", "spec": spec})
+                    except protocol.ConnectionClosed:
+                        pass  # death handling will requeue/fail it
+                else:
+                    remaining.append(spec)
+                    need_worker += 1
+        # Grow the pool for runnable-but-unassigned tasks (reference:
+        # WorkerPool starts workers on demand for lease requests).
+        for _ in range(max(0, need_worker - self._spawning_pool)):
+            self._spawn_worker(dedicated=False)
+        self._pending = remaining
+
+    def _dispatch_when_registered(self, w: WorkerInfo, spec: TaskSpec):
+        if not w.registered.wait(timeout=60):
+            logger.error("worker pid=%s never registered", w.proc.pid)
+            return
+        with self._lock:
+            if w.current_task is not spec:
+                return
+            self._inflight[spec.task_id] = w.addr
+            try:
+                w.conn.send({"kind": "execute_task", "spec": spec})
+            except protocol.ConnectionClosed:
+                pass
+
+    def _spawn_worker(self, dedicated: bool) -> WorkerInfo:
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_SESSION_NAME"] = self.session_name
+        # Workers must see the same import universe as the driver (parity:
+        # the reference serializes the driver's sys.path expectations via the
+        # worker command line, `services.py:1099`).
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p] +
+            ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+        log_path = os.path.join(self.session_dir, "logs")
+        os.makedirs(log_path, exist_ok=True)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.default_worker",
+             "--head-sock", self.sock_path,
+             "--session-dir", self.session_dir,
+             "--session-name", self.session_name],
+            env=env,
+            stdout=open(os.path.join(log_path, "worker-pending.out"), "ab"),
+            stderr=subprocess.STDOUT,
+        )
+        w = WorkerInfo(proc)
+        w.dedicated = dedicated
+        self._spawned.append(w)
+        if not dedicated:
+            self._spawning_pool += 1
+        return w
+
+    # ------------------------------------------------------------------
+    # death detection (reference: raylet monitor heartbeats + SIGCHLD)
+    # ------------------------------------------------------------------
+    def _monitor_loop(self):
+        while not self._shutdown:
+            time.sleep(0.05)
+            dead: List[WorkerInfo] = []
+            with self._lock:
+                for w in self._spawned:
+                    if w.proc.poll() is not None and not getattr(w, "_reaped", False):
+                        w._reaped = True
+                        dead.append(w)
+            for w in dead:
+                self._handle_worker_death(w)
+
+    def _handle_worker_death(self, w: WorkerInfo):
+        failed_boot = False
+        with self._lock:
+            if w.addr is not None:
+                self._unregistered_deaths = 0
+                self._workers.pop(w.addr, None)
+                try:
+                    self._idle.remove(w.addr)
+                except ValueError:
+                    pass
+            else:
+                # Died before registering: almost always an import/boot
+                # failure — make it visible instead of crash-looping.
+                if not w.dedicated:
+                    self._spawning_pool -= 1
+                self._unregistered_deaths = getattr(
+                    self, "_unregistered_deaths", 0) + 1
+                failed_boot = self._unregistered_deaths >= 3
+        if w.addr is None:
+            self._publish("error", (
+                f"worker pid={w.proc.pid} exited (code {w.proc.returncode}) "
+                f"before registering; see {self.session_dir}/logs/"))
+        if failed_boot:
+            # Stop respawning into a boot loop: fail everything pending.
+            with self._lock:
+                pending = list(self._pending)
+                self._pending.clear()
+                self._unregistered_deaths = 0
+            for spec in pending:
+                self._fail_task_to_caller(spec, WorkerCrashedError(
+                    "worker processes repeatedly failed to boot; see "
+                    f"{self.session_dir}/logs/"))
+            return
+
+        with self._lock:
+            spec = w.current_task
+            w.current_task = None
+            actor_id = w.actor_id
+            if spec is not None:
+                self._inflight.pop(spec.task_id, None)
+                self._release_resources(spec.resources)
+            retry = (spec is not None and actor_id is None
+                     and spec.retries_used < spec.max_retries)
+            if retry:
+                spec.retries_used += 1
+                self._pending.append(spec)
+            self._schedule_locked()
+
+        if actor_id is not None:
+            self._handle_actor_death(actor_id, w)
+        elif spec is not None and not retry:
+            self._fail_task_to_caller(spec, WorkerCrashedError(
+                f"worker pid={w.proc.pid} died while running "
+                f"{spec.describe()} (exit code {w.proc.returncode})"))
+
+    def _handle_actor_death(self, actor_id: ActorID, w: WorkerInfo):
+        with self._lock:
+            info = self._actors.get(actor_id)
+            if info is None or info.state == DEAD:
+                return
+            if info.restarts_left != 0:
+                if info.restarts_left > 0:
+                    info.restarts_left -= 1
+                info.state = RESTARTING
+                info.addr = None
+                view = info.view()
+                # Re-run the creation task (reference semantics:
+                # max_reconstructions replays the creation task,
+                # doc/source/fault-tolerance.rst:48).
+                self._pending.append(info.spec)
+                self._schedule_locked()
+            else:
+                info.state = DEAD
+                info.death_reason = f"worker pid={w.proc.pid} exited"
+                info.addr = None
+                self._release_actor_name_locked(info)
+                view = info.view()
+        self._publish("actor:" + actor_id.hex(), view)
+
+    def _release_actor_name_locked(self, info: ActorInfo):
+        """Free a named actor's name when it dies for good, so the name can
+        be reused (reference: named actor entries are cleaned on death)."""
+        name = info.spec.name
+        if name:
+            key = "named_actor:" + name
+            if self._kv.get(key) == info.spec.actor_id.binary():
+                del self._kv[key]
+
+    def _fail_task_to_caller(self, spec: TaskSpec, error: Exception):
+        with self._lock:
+            conn = self._conns_by_addr.get(spec.caller_addr)
+        if conn is None:
+            return
+        try:
+            for oid in spec.return_ids():
+                conn.send({"kind": "push_result", "object_id": oid,
+                           "error": error})
+        except protocol.ConnectionClosed:
+            pass
+
+    # ------------------------------------------------------------------
+    def start_pool_workers(self, n: int):
+        with self._lock:
+            for _ in range(n):
+                self._spawn_worker(dedicated=False)
+
+    def shutdown(self):
+        with self._lock:
+            self._shutdown = True
+            workers = list(self._spawned)
+        for w in workers:
+            if w.conn is not None:
+                try:
+                    w.conn.send({"kind": "shutdown"})
+                except protocol.ConnectionClosed:
+                    pass
+        deadline = time.monotonic() + 2.0
+        for w in workers:
+            remaining = deadline - time.monotonic()
+            try:
+                w.proc.wait(timeout=max(0.05, remaining))
+            except subprocess.TimeoutExpired:
+                try:
+                    w.proc.kill()
+                    w.proc.wait(timeout=5)
+                except OSError:
+                    pass
+        self.server.close()

@@ -1,0 +1,132 @@
+"""Binary identifiers for jobs, tasks, objects, actors, and nodes.
+
+Design parity: the reference uses 20-byte binary ids with lineage embedded in
+the object id (object index inside the parent task id) — see
+`src/ray/common/id.h` in the reference tree. We keep the same shape: a
+16-byte random unique part plus structured suffixes, rendered as hex for
+debugging and for naming shared-memory segments.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+_UNIQUE_LEN = 16  # random bytes per unique id
+_INDEX_LEN = 4  # big-endian object index appended to a TaskID
+
+
+class BaseID:
+    __slots__ = ("_bytes", "_hash")
+
+    def __init__(self, raw: bytes):
+        if not isinstance(raw, bytes):
+            raise TypeError(f"id must be bytes, got {type(raw)}")
+        self._bytes = raw
+        self._hash = hash(raw)
+
+    def binary(self) -> bytes:
+        return self._bytes
+
+    def hex(self) -> str:
+        return self._bytes.hex()
+
+    def __hash__(self):
+        return self._hash
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other._bytes == self._bytes
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._bytes.hex()[:16]})"
+
+    def __reduce__(self):
+        # Rebuild through __init__ so `_hash` is recomputed in the receiving
+        # process — `hash(bytes)` is randomized per process, so a pickled
+        # cached hash would poison dict lookups.
+        return (type(self), (self._bytes,))
+
+    @classmethod
+    def from_hex(cls, h: str):
+        return cls(bytes.fromhex(h))
+
+
+class JobID(BaseID):
+    @classmethod
+    def generate(cls) -> "JobID":
+        return cls(os.urandom(4))
+
+    @classmethod
+    def nil(cls) -> "JobID":
+        return cls(b"\x00" * 4)
+
+
+class NodeID(BaseID):
+    @classmethod
+    def generate(cls) -> "NodeID":
+        return cls(os.urandom(_UNIQUE_LEN))
+
+
+class WorkerID(BaseID):
+    @classmethod
+    def generate(cls) -> "WorkerID":
+        return cls(os.urandom(_UNIQUE_LEN))
+
+
+class ActorID(BaseID):
+    @classmethod
+    def generate(cls) -> "ActorID":
+        return cls(os.urandom(_UNIQUE_LEN))
+
+    @classmethod
+    def nil(cls) -> "ActorID":
+        return cls(b"\x00" * _UNIQUE_LEN)
+
+    def is_nil(self) -> bool:
+        return self._bytes == b"\x00" * _UNIQUE_LEN
+
+
+class TaskID(BaseID):
+    @classmethod
+    def generate(cls) -> "TaskID":
+        return cls(os.urandom(_UNIQUE_LEN))
+
+    def object_id(self, index: int) -> "ObjectID":
+        """Return the id of the `index`-th return value of this task.
+
+        Mirrors the reference's lineage-embedding scheme
+        (`src/ray/common/id.h`: ObjectID = TaskID + index).
+        """
+        return ObjectID(self._bytes + index.to_bytes(_INDEX_LEN, "big"))
+
+
+class ObjectID(BaseID):
+    @classmethod
+    def generate(cls) -> "ObjectID":
+        """A put() object: random task-part + index 0."""
+        return TaskID.generate().object_id(0)
+
+    def task_id(self) -> TaskID:
+        return TaskID(self._bytes[:_UNIQUE_LEN])
+
+    def index(self) -> int:
+        return int.from_bytes(self._bytes[_UNIQUE_LEN:], "big")
+
+
+class PlacementGroupID(BaseID):
+    @classmethod
+    def generate(cls) -> "PlacementGroupID":
+        return cls(os.urandom(_UNIQUE_LEN))
+
+
+class _Counter:
+    """Thread-safe monotonically increasing counter (for sequence numbers)."""
+
+    def __init__(self):
+        self._value = 0
+        self._lock = threading.Lock()
+
+    def next(self) -> int:
+        with self._lock:
+            self._value += 1
+            return self._value

@@ -1,0 +1,128 @@
+"""Local-mode runtime: executes everything inline in the driver process.
+
+Parity: `ray.init(local_mode=True)` in the reference — for debugging;
+tasks/actors run synchronously, no worker processes are spawned.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional, Tuple
+
+import cloudpickle
+
+from ..exceptions import ActorDiedError, TaskError
+from .ids import ActorID, JobID, ObjectID, TaskID
+from .object_ref import ObjectRef
+
+
+class LocalRuntime:
+    def __init__(self):
+        self.addr = "local"
+        self.job_id = JobID.generate()
+        self._objects: Dict[ObjectID, object] = {}
+        self._errors: Dict[ObjectID, BaseException] = {}
+        self._functions: Dict[str, object] = {}
+        self._actors: Dict[ActorID, object] = {}
+
+    # -- objects ---------------------------------------------------------
+    def put(self, value) -> ObjectRef:
+        oid = ObjectID.generate()
+        self._objects[oid] = value
+        return ObjectRef(oid, self.addr)
+
+    def get(self, refs, timeout=None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        out = []
+        for r in refs:
+            if r.id in self._errors:
+                raise self._errors[r.id]
+            out.append(self._objects[r.id])
+        return out[0] if single else out
+
+    def wait(self, refs, num_returns=1, timeout=None) -> Tuple[list, list]:
+        return refs[:num_returns], refs[num_returns:]
+
+    def free(self, refs):
+        for r in refs:
+            self._objects.pop(r.id, None)
+            self._errors.pop(r.id, None)
+
+    # -- functions -------------------------------------------------------
+    def export_function(self, key: str, data: bytes):
+        if key not in self._functions:
+            self._functions[key] = cloudpickle.loads(data)
+
+    def _resolve(self, args, kwargs):
+        def one(v):
+            return self.get(v) if isinstance(v, ObjectRef) else v
+        return [one(a) for a in args], {k: one(v) for k, v in kwargs.items()}
+
+    def _store_result(self, task_id: TaskID, num_returns: int, result,
+                      error: Optional[BaseException]):
+        refs = [ObjectRef(task_id.object_id(i), self.addr)
+                for i in range(num_returns)]
+        if error is not None:
+            for r in refs:
+                self._errors[r.id] = error
+            return refs
+        values = [result] if num_returns == 1 else list(result)
+        for r, v in zip(refs, values):
+            self._objects[r.id] = v
+        return refs
+
+    # -- tasks -----------------------------------------------------------
+    def submit_task(self, function_key, args, kwargs, num_returns=1,
+                    resources=None, max_retries=0, name="") -> List[ObjectRef]:
+        fn = self._functions[function_key]
+        a, kw = self._resolve(args, kwargs)
+        try:
+            result, error = fn(*a, **kw), None
+        except Exception as e:
+            result, error = None, TaskError.from_exception(e, name or function_key)
+        return self._store_result(TaskID.generate(), num_returns, result, error)
+
+    # -- actors ----------------------------------------------------------
+    def create_actor(self, class_key, args, kwargs, resources=None,
+                     max_restarts=0, max_concurrency=1, is_asyncio=False,
+                     name="") -> ActorID:
+        cls = self._functions[class_key]
+        a, kw = self._resolve(args, kwargs)
+        actor_id = ActorID.generate()
+        self._actors[actor_id] = cls(*a, **kw)
+        if name:
+            self._functions["named_actor:" + name] = actor_id
+        return actor_id
+
+    def submit_actor_task(self, actor_id, method_name, args, kwargs,
+                          num_returns=1, name="", timeout=None) -> List[ObjectRef]:
+        inst = self._actors.get(actor_id)
+        if inst is None:
+            raise ActorDiedError(actor_id.hex(), "actor killed (local mode)")
+        a, kw = self._resolve(args, kwargs)
+        try:
+            result, error = getattr(inst, method_name)(*a, **kw), None
+        except Exception as e:
+            result, error = None, TaskError.from_exception(e, method_name)
+        return self._store_result(TaskID.generate(), num_returns, result, error)
+
+    def kill_actor(self, actor_id, no_restart=True):
+        self._actors.pop(actor_id, None)
+
+    def get_named_actor(self, name):
+        actor_id = self._functions.get("named_actor:" + name)
+        if actor_id is None or actor_id not in self._actors:
+            return None
+        return {"actor_id": actor_id, "state": "ALIVE", "addr": self.addr,
+                "name": name, "death_reason": "", "restarts_left": 0}
+
+    def cluster_info(self):
+        return {"total_resources": {"CPU": 1.0}, "available_resources": {},
+                "num_workers": 0, "num_pending_tasks": 0, "actors": {},
+                "session_name": "local", "session_dir": ""}
+
+    def shutdown(self):
+        self._objects.clear()
+        self._actors.clear()

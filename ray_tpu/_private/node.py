@@ -1,0 +1,125 @@
+"""Session/node bring-up: the `ray_tpu.init()` backend.
+
+Parity: `python/ray/node.py` — the process supervisor that creates the
+session directory, starts node services, and connects the driver. Our head
+services (scheduler + GCS + monitor) run as threads in the driver process;
+worker processes are spawned on demand by the head (`head.py`).
+"""
+
+from __future__ import annotations
+
+import atexit
+import datetime
+import os
+import shutil
+import tempfile
+import threading
+from typing import Dict, Optional
+
+from .head import HeadServer
+from .runtime import Runtime
+from . import worker_state
+
+_lock = threading.Lock()
+_node: Optional["Node"] = None
+
+
+def default_resources() -> Dict[str, float]:
+    ncpu = os.cpu_count() or 1
+    # Scheduling here gates *process concurrency*, not raw FLOPs; workers are
+    # mostly I/O- or device-bound, so allow a sane minimum of parallelism
+    # even on tiny CI hosts.
+    return {"CPU": float(max(ncpu, 4))}
+
+
+def detect_tpus() -> float:
+    """Count local TPU devices if jax is already imported (cheap); otherwise
+    report 0 and let the user pass resources={"TPU": n} explicitly."""
+    import sys
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return 0.0
+    try:
+        devs = jax.devices()
+    except Exception:
+        return 0.0
+    return float(len([d for d in devs if d.platform != "cpu"]))
+
+
+class Node:
+    def __init__(self, resources: Dict[str, float], num_initial_workers: int,
+                 session_root: Optional[str] = None,
+                 worker_env: Optional[dict] = None):
+        ts = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
+        self.session_name = f"{ts}-{os.getpid()}-{os.urandom(2).hex()}"
+        # Note: deliberately NOT "<tmp>/ray_tpu" — a directory named like the
+        # package next to a user's cwd would shadow the real package as a
+        # namespace package.
+        root = session_root or os.path.join(tempfile.gettempdir(),
+                                            "ray-tpu-sessions")
+        self.session_dir = os.path.join(root, f"session_{self.session_name}")
+        os.makedirs(self.session_dir, exist_ok=True)
+        self.head = HeadServer(self.session_dir, self.session_name, resources,
+                               worker_env=worker_env)
+        if num_initial_workers > 0:
+            self.head.start_pool_workers(num_initial_workers)
+        self.runtime = Runtime(self.session_dir, self.session_name,
+                               self.head.sock_path, role="driver")
+
+    def shutdown(self):
+        try:
+            self.runtime.shutdown()
+        finally:
+            self.head.shutdown()
+            self.runtime.shm.cleanup_session()
+            shutil.rmtree(self.session_dir, ignore_errors=True)
+
+
+def init(resources: Optional[Dict[str, float]] = None,
+         num_cpus: Optional[float] = None,
+         num_tpus: Optional[float] = None,
+         num_initial_workers: int = 0,
+         worker_env: Optional[dict] = None) -> "Node":
+    global _node
+    with _lock:
+        if _node is not None:
+            raise RuntimeError("ray_tpu.init() called twice; call "
+                               "ray_tpu.shutdown() first")
+        res = default_resources()
+        if num_cpus is not None:
+            res["CPU"] = float(num_cpus)
+        tpus = num_tpus if num_tpus is not None else detect_tpus()
+        if tpus:
+            res["TPU"] = float(tpus)
+        if resources:
+            res.update({k: float(v) for k, v in resources.items()})
+        node = Node(res, num_initial_workers, worker_env=worker_env)
+        _node = node
+        worker_state.set_runtime(node.runtime, worker_state.SCRIPT_MODE)
+        atexit.register(_atexit_shutdown)
+        return node
+
+
+def _atexit_shutdown():
+    try:
+        shutdown()
+    except Exception:
+        pass
+
+
+def shutdown():
+    global _node
+    with _lock:
+        node = _node
+        _node = None
+    worker_state.clear()
+    if node is not None:
+        node.shutdown()
+
+
+def is_initialized() -> bool:
+    return _node is not None or worker_state.get_runtime_or_none() is not None
+
+
+def current_node() -> Optional[Node]:
+    return _node

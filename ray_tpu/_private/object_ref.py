@@ -1,0 +1,43 @@
+"""ObjectRef: a handle to a (possibly not-yet-computed) object value.
+
+Parity: the reference's `ObjectID`/`ObjectRef` with ownership embedded — the
+reference resolves foreign refs by asking the owner's CoreWorker
+(`src/ray/core_worker/future_resolver.cc`); we embed the owner's server
+address in the ref so any borrower can dial the owner directly.
+"""
+
+from __future__ import annotations
+
+from .ids import ObjectID
+
+
+class ObjectRef:
+    __slots__ = ("id", "owner_addr", "size_hint")
+
+    def __init__(self, oid: ObjectID, owner_addr: str = "", size_hint: int = 0):
+        self.id = oid
+        self.owner_addr = owner_addr
+        self.size_hint = size_hint
+
+    def hex(self) -> str:
+        return self.id.hex()
+
+    def binary(self) -> bytes:
+        return self.id.binary()
+
+    def __hash__(self):
+        return hash(self.id)
+
+    def __eq__(self, other):
+        return isinstance(other, ObjectRef) and other.id == self.id
+
+    def __repr__(self):
+        return f"ObjectRef({self.id.hex()[:16]})"
+
+    def __reduce__(self):
+        return (ObjectRef, (self.id, self.owner_addr, self.size_hint))
+
+    # Keep users from iterating a ref thinking it's the value.
+    def __iter__(self):
+        raise TypeError(
+            "ObjectRef is not iterable; call ray_tpu.get(ref) first.")

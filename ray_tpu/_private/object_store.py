@@ -1,0 +1,180 @@
+"""Node-local shared-memory object store + per-process memory store.
+
+Parity: the reference keeps small/direct-call results in an in-process
+`CoreWorkerMemoryStore` (`src/ray/core_worker/store_provider/memory_store/`)
+and large objects in the plasma daemon (mmap shared memory, zero-copy reads,
+`store_provider/plasma_store_provider.h`). Here:
+
+- `MemoryStore`: per-process dict of deserialized values (small results pushed
+  directly owner→borrower) plus waiter wakeups.
+- `SharedObjectStore`: objects are files under /dev/shm, one per object,
+  named `raytpu_<session>_<object hex>`, written+sealed by the creating
+  process and mmap'd read-only by readers (zero-copy numpy views). Sealing is
+  atomic via a rename from a `.tmp` name. This is deliberately daemonless for
+  the Python tier; the native C++ daemon (src/store/) adds eviction and
+  capacity accounting on the same layout.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import threading
+from typing import Dict, Optional
+
+from . import serialization
+from .ids import ObjectID
+
+SHM_DIR = os.environ.get("RAY_TPU_SHM_DIR", "/dev/shm")
+# Objects smaller than this are pushed inline over sockets rather than via
+# shm (reference: `max_direct_call_object_size` = 100 KiB,
+# `src/ray/common/ray_config_def.h:54`).
+INLINE_OBJECT_MAX = 100 * 1024
+
+
+class ObjectEntry:
+    __slots__ = ("value", "has_value")
+
+    def __init__(self, value):
+        self.value = value
+        self.has_value = True
+
+
+class MemoryStore:
+    """In-process store of deserialized object values with blocking get."""
+
+    def __init__(self):
+        self._objects: Dict[ObjectID, object] = {}
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+
+    def put(self, oid: ObjectID, value) -> None:
+        with self._cv:
+            self._objects[oid] = ObjectEntry(value)
+            self._cv.notify_all()
+
+    def contains(self, oid: ObjectID) -> bool:
+        with self._lock:
+            return oid in self._objects
+
+    def get_if_exists(self, oid: ObjectID):
+        with self._lock:
+            return self._objects.get(oid)
+
+    def wait_for(self, oid: ObjectID, timeout: Optional[float]) -> Optional[ObjectEntry]:
+        deadline = None if timeout is None else (timeout + _now())
+        with self._cv:
+            while oid not in self._objects:
+                remaining = None if deadline is None else deadline - _now()
+                if remaining is not None and remaining <= 0:
+                    return None
+                self._cv.wait(remaining)
+            return self._objects[oid]
+
+    def delete(self, oid: ObjectID) -> None:
+        with self._cv:
+            self._objects.pop(oid, None)
+
+    def size(self) -> int:
+        with self._lock:
+            return len(self._objects)
+
+
+def _now():
+    import time
+    return time.monotonic()
+
+
+class _Pin:
+    """Keeps an mmap (and its file) alive while zero-copy views exist."""
+
+    __slots__ = ("mm",)
+
+    def __init__(self, mm):
+        self.mm = mm
+
+
+class SharedObjectStore:
+    """Shared-memory object store over /dev/shm files."""
+
+    def __init__(self, session_name: str):
+        self.session_name = session_name
+        self.prefix = os.path.join(SHM_DIR, f"raytpu_{session_name}_")
+        # Pins: mmaps we must keep open because deserialized values alias them.
+        self._pins: Dict[ObjectID, _Pin] = {}
+        self._lock = threading.Lock()
+
+    def _path(self, oid: ObjectID) -> str:
+        return self.prefix + oid.hex()
+
+    # -- writer side -----------------------------------------------------
+    def create_and_seal(self, oid: ObjectID, meta: bytes, buffers, total: int) -> None:
+        path = self._path(oid)
+        tmp = path + ".tmp"
+        fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
+        try:
+            os.ftruncate(fd, max(total, 1))
+            with mmap.mmap(fd, max(total, 1)) as mm:
+                serialization.write_blob(memoryview(mm), meta, buffers)
+        finally:
+            os.close(fd)
+        os.rename(tmp, path)  # atomic seal
+
+    def put_serialized(self, oid: ObjectID, value) -> int:
+        meta, buffers, total = serialization.serialize(value)
+        self.create_and_seal(oid, meta, buffers, total)
+        return total
+
+    # -- reader side -----------------------------------------------------
+    def contains(self, oid: ObjectID) -> bool:
+        return os.path.exists(self._path(oid))
+
+    def get(self, oid: ObjectID):
+        """Zero-copy read; returns None if the object is not sealed yet.
+
+        The mmap is pinned for the life of this store (freed on delete), so
+        returned numpy views stay valid.
+        """
+        path = self._path(oid)
+        try:
+            fd = os.open(path, os.O_RDONLY)
+        except FileNotFoundError:
+            return None
+        try:
+            size = os.fstat(fd).st_size
+            mm = mmap.mmap(fd, size, prot=mmap.PROT_READ)
+        finally:
+            os.close(fd)
+        value = serialization.loads(memoryview(mm), zero_copy=True)
+        with self._lock:
+            self._pins[oid] = _Pin(mm)
+        return ObjectEntry(value)
+
+    def delete(self, oid: ObjectID) -> None:
+        with self._lock:
+            self._pins.pop(oid, None)
+        try:
+            os.unlink(self._path(oid))
+        except FileNotFoundError:
+            pass
+
+    def cleanup_session(self) -> None:
+        """Unlink every object file belonging to this session."""
+        import glob
+        with self._lock:
+            self._pins.clear()
+        for path in glob.glob(self.prefix + "*"):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def used_bytes(self) -> int:
+        import glob
+        total = 0
+        for path in glob.glob(self.prefix + "*"):
+            try:
+                total += os.stat(path).st_size
+            except OSError:
+                pass
+        return total

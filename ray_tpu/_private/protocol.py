@@ -1,0 +1,261 @@
+"""Socket transport: length-prefixed pickled messages with request/reply.
+
+Transport parity note: the reference's control plane is gRPC + asio Unix
+sockets (`src/ray/rpc/grpc_server.cc`, `src/ray/common/client_connection.cc`).
+Here every process exposes one Unix-domain-socket server; peers hold direct
+persistent connections (the "direct call" topology of the reference's
+`direct_task_transport.h` / `direct_actor_transport.h`). Messages are Python
+dicts with a `kind` field, serialized with pickle protocol 5. Requests carry
+a `seq`; replies echo it as `reply_to`.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+import socket
+import struct
+import threading
+from typing import Callable, Dict, Optional
+
+logger = logging.getLogger(__name__)
+
+_LEN = struct.Struct("<Q")
+PICKLE_PROTOCOL = 5
+
+
+class ConnectionClosed(Exception):
+    pass
+
+
+def _send_msg(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = sock.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionClosed()
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+def _recv_msg(sock: socket.socket) -> bytes:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return _recv_exact(sock, n)
+
+
+class _ReplyFuture:
+    __slots__ = ("_ev", "_value", "_exc")
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._value = None
+        self._exc = None
+
+    def set(self, value):
+        self._value = value
+        self._ev.set()
+
+    def set_exception(self, exc):
+        self._exc = exc
+        self._ev.set()
+
+    def result(self, timeout=None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("rpc timed out")
+        if self._exc is not None:
+            raise self._exc
+        return self._value
+
+
+class Connection:
+    """A bidirectional message channel to one peer.
+
+    One background thread reads messages; `kind == "reply"` resolves pending
+    request futures, everything else is dispatched to `handler(conn, msg)`.
+    Handlers must be fast or hand off to their own executor.
+    """
+
+    def __init__(self, sock: socket.socket, handler: Callable, peer_addr: str = "",
+                 on_close: Optional[Callable] = None):
+        self.sock = sock
+        self.handler = handler
+        self.peer_addr = peer_addr  # advertised server address of the peer
+        self.on_close = on_close
+        self.closed = False
+        self._send_lock = threading.Lock()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._pending: Dict[int, _ReplyFuture] = {}
+        self._thread = threading.Thread(
+            target=self._recv_loop, daemon=True, name=f"conn-recv-{peer_addr}")
+        self._thread.start()
+
+    # -- sending ---------------------------------------------------------
+    def send(self, msg: dict) -> None:
+        payload = pickle.dumps(msg, protocol=PICKLE_PROTOCOL)
+        try:
+            with self._send_lock:
+                _send_msg(self.sock, payload)
+        except (OSError, ConnectionClosed) as e:
+            self._handle_close()
+            raise ConnectionClosed(str(e)) from e
+
+    def request(self, msg: dict, timeout: Optional[float] = None):
+        """Send a message and block for its reply; returns the reply dict."""
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        fut = _ReplyFuture()
+        self._pending[seq] = fut
+        msg = dict(msg)
+        msg["seq"] = seq
+        try:
+            self.send(msg)
+            reply = fut.result(timeout)
+        finally:
+            self._pending.pop(seq, None)
+        if reply.get("error") is not None:
+            raise reply["error"]
+        return reply
+
+    def reply(self, req: dict, **fields) -> None:
+        self.send({"kind": "reply", "reply_to": req["seq"], **fields})
+
+    def reply_error(self, req: dict, error: BaseException) -> None:
+        self.send({"kind": "reply", "reply_to": req["seq"], "error": error})
+
+    # -- receiving -------------------------------------------------------
+    def _recv_loop(self):
+        try:
+            while True:
+                payload = _recv_msg(self.sock)
+                msg = pickle.loads(payload)
+                if msg.get("kind") == "reply":
+                    fut = self._pending.get(msg["reply_to"])
+                    if fut is not None:
+                        fut.set(msg)
+                else:
+                    try:
+                        self.handler(self, msg)
+                    except Exception:
+                        logger.exception("error handling %s", msg.get("kind"))
+        except (ConnectionClosed, OSError, EOFError, pickle.UnpicklingError):
+            pass
+        finally:
+            self._handle_close()
+
+    def _handle_close(self):
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        for fut in list(self._pending.values()):
+            fut.set_exception(ConnectionClosed(f"peer {self.peer_addr} closed"))
+        if self.on_close is not None:
+            try:
+                self.on_close(self)
+            except Exception:
+                logger.exception("on_close callback failed")
+
+    def close(self):
+        self._handle_close()
+
+
+class Server:
+    """Unix-socket accept loop; each accepted socket becomes a Connection.
+
+    The first message on every inbound connection must be
+    `{"kind": "hello", "addr": <peer server addr>}` so we can key the
+    connection by the peer's advertised address.
+    """
+
+    def __init__(self, path: str, handler: Callable,
+                 on_connect: Optional[Callable] = None,
+                 on_close: Optional[Callable] = None):
+        self.path = path
+        self.handler = handler
+        self.on_connect = on_connect
+        self.on_close = on_close
+        if os.path.exists(path):
+            os.unlink(path)
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._sock.bind(path)
+        self._sock.listen(256)
+        self.connections: Dict[str, Connection] = {}
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name=f"server-{path}")
+        self._thread.start()
+
+    def _accept_loop(self):
+        while not self._stopped:
+            try:
+                sock, _ = self._sock.accept()
+            except OSError:
+                break
+            threading.Thread(
+                target=self._handshake, args=(sock,), daemon=True).start()
+
+    def _handshake(self, sock: socket.socket):
+        try:
+            hello = pickle.loads(_recv_msg(sock))
+            assert hello.get("kind") == "hello", hello
+            peer_addr = hello.get("addr", "")
+        except Exception:
+            sock.close()
+            return
+        conn = Connection(sock, self.handler, peer_addr, on_close=self._on_conn_close)
+        with self._lock:
+            self.connections[peer_addr] = conn
+        if self.on_connect is not None:
+            self.on_connect(conn, hello)
+
+    def _on_conn_close(self, conn: Connection):
+        with self._lock:
+            if self.connections.get(conn.peer_addr) is conn:
+                del self.connections[conn.peer_addr]
+        if self.on_close is not None:
+            self.on_close(conn)
+
+    def close(self):
+        self._stopped = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            conns = list(self.connections.values())
+        for c in conns:
+            c.close()
+        if os.path.exists(self.path):
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+def connect(path: str, my_addr: str, handler: Callable,
+            hello_extra: Optional[dict] = None,
+            on_close: Optional[Callable] = None,
+            timeout: float = 30.0) -> Connection:
+    """Dial a peer's Unix-socket server and perform the hello handshake."""
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    sock.connect(path)
+    sock.settimeout(None)
+    hello = {"kind": "hello", "addr": my_addr}
+    if hello_extra:
+        hello.update(hello_extra)
+    _send_msg(sock, pickle.dumps(hello, protocol=PICKLE_PROTOCOL))
+    return Connection(sock, handler, peer_addr=path, on_close=on_close)

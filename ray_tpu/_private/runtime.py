@@ -1,0 +1,743 @@
+"""Per-process runtime: object API, task submission, and the execution loop.
+
+Parity: the reference's `CoreWorker` (`src/ray/core_worker/core_worker.h:41`)
+— every driver and worker process embeds one. It provides:
+
+- object API: `put` / `get` / `wait` with an in-process memory store for
+  small direct-call results and the shared-memory store for large values
+  (reference: memory store + plasma promotion, `core_worker.cc:384/427`);
+- task API: `submit_task`, `create_actor`, `submit_actor_task`
+  (`core_worker.cc:649/677/721`), with args inlined when small and spilled
+  to the shared store when large (reference `prepare_args`,
+  `_raylet.pyx:963`);
+- the execution loop on workers (`StartExecutingTasks`, `core_worker.cc:861`)
+  including ordered per-caller actor task streams with `max_concurrency`
+  and asyncio actors (reference `direct_actor_transport.h:239,205`,
+  `fiber.h`);
+- foreign-ref resolution by dialing the owner embedded in the ref
+  (reference `future_resolver.cc`).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import inspect
+import logging
+import os
+import queue
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Set, Tuple
+
+import cloudpickle
+
+from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
+                          TaskError)
+from . import protocol, serialization
+from .ids import ActorID, JobID, ObjectID, TaskID
+from .object_ref import ObjectRef
+from .object_store import INLINE_OBJECT_MAX, MemoryStore, SharedObjectStore
+from .task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK, NORMAL_TASK, ArgSpec,
+                        TaskSpec)
+
+logger = logging.getLogger(__name__)
+
+
+class _Cell:
+    """Memory-store slot: raw serialized bytes, a decoded value, a pointer
+    into the shared store, or an error."""
+
+    __slots__ = ("kind", "payload")
+
+    def __init__(self, kind: str, payload=None):
+        self.kind = kind  # 'raw' | 'value' | 'shm' | 'error'
+        self.payload = payload
+
+
+class ActorState:
+    def __init__(self, spec: TaskSpec, instance):
+        self.spec = spec
+        self.instance = instance
+        self.streams: Dict[str, dict] = {}  # caller addr -> {next, buffer}
+        self.lock = threading.Lock()
+        if spec.is_asyncio:
+            self.loop = asyncio.new_event_loop()
+            self.sem = None  # created on the loop
+            threading.Thread(target=self._run_loop, daemon=True,
+                             name="actor-asyncio").start()
+            self.executor = None
+        else:
+            self.loop = None
+            self.executor = ThreadPoolExecutor(
+                max_workers=max(1, spec.max_concurrency),
+                thread_name_prefix="actor-exec")
+
+    def _run_loop(self):
+        asyncio.set_event_loop(self.loop)
+        self.sem = asyncio.Semaphore(max(1, self.spec.max_concurrency))
+        self.loop.run_forever()
+
+
+class Runtime:
+    """One per process. `role` is "driver" or "worker"."""
+
+    def __init__(self, session_dir: str, session_name: str, head_sock: str,
+                 role: str, job_id: Optional[JobID] = None):
+        self.role = role
+        self.session_dir = session_dir
+        self.session_name = session_name
+        sock_dir = os.path.join(session_dir, "sock")
+        os.makedirs(sock_dir, exist_ok=True)
+        self.addr = os.path.join(
+            sock_dir, f"{role}-{os.getpid()}-{os.urandom(3).hex()}.sock")
+        self.job_id = job_id or JobID.generate()
+
+        self.memory = MemoryStore()
+        self.shm = SharedObjectStore(session_name)
+
+        self._conns: Dict[str, protocol.Connection] = {}
+        self._conns_lock = threading.Lock()
+        self._fn_cache: Dict[str, object] = {}
+        self._exported: Set[str] = set()
+        self._export_lock = threading.Lock()
+
+        # Actor-client state.
+        self._actor_cache: Dict[ActorID, dict] = {}
+        self._actor_events: Dict[ActorID, threading.Event] = {}
+        self._actor_seqs: Dict[Tuple[ActorID], int] = {}
+        self._seq_lock = threading.Lock()
+        # Actor tasks in flight per destination addr, to fail them fast on
+        # connection loss (reference: CoreWorkerDirectActorTaskSubmitter
+        # marks tasks failed on DisconnectClient).
+        self._pending_to_addr: Dict[str, Dict[TaskID, TaskSpec]] = {}
+        self._pending_lock = threading.Lock()
+
+        # Objects another process asked for before they were ready: owner
+        # forwards the result when it arrives.
+        self._object_waiters: Dict[ObjectID, Set[str]] = {}
+        self._waiters_lock = threading.Lock()
+        self._fetching: Set[ObjectID] = set()
+
+        # Worker-side execution state.
+        self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
+        self._actor: Optional[ActorState] = None
+        self._shutdown_event = threading.Event()
+
+        self.server = protocol.Server(
+            self.addr, self._handle, on_close=self._on_peer_close)
+        self.head = protocol.connect(
+            head_sock, self.addr, self._handle,
+            hello_extra={"role": role, "pid": os.getpid()},
+            on_close=self._on_head_close)
+
+        if role == "worker":
+            threading.Thread(target=self._task_loop, daemon=True,
+                             name="task-exec").start()
+
+    # ==================================================================
+    # object API
+    # ==================================================================
+    def put(self, value) -> ObjectRef:
+        if isinstance(value, ObjectRef):
+            raise TypeError("put() of an ObjectRef is not allowed")
+        oid = ObjectID.generate()
+        size = self.shm.put_serialized(oid, value)
+        return ObjectRef(oid, self.addr, size)
+
+    def get(self, refs, timeout: Optional[float] = None):
+        single = isinstance(refs, ObjectRef)
+        if single:
+            refs = [refs]
+        deadline = None if timeout is None else time.monotonic() + timeout
+        values = [self._get_one(r, deadline) for r in refs]
+        return values[0] if single else values
+
+    def _remaining(self, deadline) -> Optional[float]:
+        if deadline is None:
+            return None
+        rem = deadline - time.monotonic()
+        if rem <= 0:
+            raise GetTimeoutError("ray_tpu.get timed out")
+        return rem
+
+    def _decode_cell(self, oid: ObjectID, cell: _Cell):
+        if cell.kind == "error":
+            raise cell.payload
+        if cell.kind == "value":
+            return cell.payload
+        if cell.kind == "raw":
+            value = serialization.loads(cell.payload, zero_copy=False)
+            self.memory.put(oid, _Cell("value", value))
+            return value
+        if cell.kind == "shm":
+            entry = self.shm.get(oid)
+            if entry is None:
+                raise ObjectLostError(f"object {oid.hex()[:16]} missing from store")
+            self.memory.put(oid, _Cell("value", entry.value))
+            return entry.value
+        raise AssertionError(cell.kind)
+
+    def _get_one(self, ref: ObjectRef, deadline):
+        cell_entry = self.memory.get_if_exists(ref.id)
+        if cell_entry is not None:
+            return self._decode_cell(ref.id, cell_entry.value)
+        entry = self.shm.get(ref.id)
+        if entry is not None:
+            self.memory.put(ref.id, _Cell("value", entry.value))
+            return entry.value
+        if ref.owner_addr and ref.owner_addr != self.addr:
+            self._request_from_owner(ref)
+        # Wait for a push (own task result, or owner's pending push), with a
+        # periodic shm re-check guarding against missed notifications.
+        while True:
+            rem = self._remaining(deadline)
+            step = 5.0 if rem is None else min(rem, 5.0)
+            got = self.memory.wait_for(ref.id, step)
+            if got is not None:
+                return self._decode_cell(ref.id, got.value)
+            entry = self.shm.get(ref.id)
+            if entry is not None:
+                return entry.value
+
+    def _request_from_owner(self, ref: ObjectRef):
+        """Ask the owner for the value; on completion the result (or error)
+        lands in the memory store, or the value is in the shared store."""
+        try:
+            try:
+                conn = self._get_conn(ref.owner_addr)
+                reply = conn.request(
+                    {"kind": "get_object", "object_id": ref.id}, timeout=60)
+            except (protocol.ConnectionClosed, FileNotFoundError,
+                    ConnectionRefusedError):
+                if not self.shm.contains(ref.id):
+                    self.memory.put(ref.id, _Cell("error", ObjectLostError(
+                        f"owner of {ref.id.hex()[:16]} is unreachable")))
+                return
+            except Exception as e:
+                # The owner replied with an error cell (request() re-raises
+                # it); an errored object counts as "ready" for wait()/get().
+                self.memory.put(ref.id, _Cell("error", e))
+                return
+            status = reply["status"]
+            if status == "inline":
+                self.memory.put(ref.id, _Cell("raw", reply["data"]))
+            elif status == "shm":
+                self.memory.put(ref.id, _Cell("shm"))
+            elif status == "lost":
+                self.memory.put(ref.id, _Cell("error", ObjectLostError(
+                    f"object {ref.id.hex()[:16]} was lost")))
+            # 'pending': owner will push_result when sealed.
+        finally:
+            self._fetching.discard(ref.id)
+
+    def wait(self, refs: List[ObjectRef], num_returns: int = 1,
+             timeout: Optional[float] = None) -> Tuple[list, list]:
+        if num_returns > len(refs):
+            raise ValueError("num_returns exceeds number of refs")
+        deadline = None if timeout is None else time.monotonic() + timeout
+        # Kick off fetches for borrowed refs so readiness can become local.
+        for r in refs:
+            if (r.owner_addr and r.owner_addr != self.addr
+                    and not self.memory.contains(r.id)
+                    and r.id not in self._fetching):
+                self._fetching.add(r.id)
+                threading.Thread(target=self._request_from_owner, args=(r,),
+                                 daemon=True).start()
+        sleep = 0.0005
+        while True:
+            ready = [r for r in refs
+                     if self.memory.contains(r.id) or self.shm.contains(r.id)]
+            timed_out = deadline is not None and time.monotonic() >= deadline
+            if len(ready) >= num_returns or timed_out:
+                ready = ready[:num_returns]
+                ready_set = set(ready)
+                not_ready = [r for r in refs if r not in ready_set]
+                return ready, not_ready
+            time.sleep(sleep)
+            sleep = min(sleep * 2, 0.01)
+
+    def free(self, refs: List[ObjectRef]):
+        for r in refs:
+            self.memory.delete(r.id)
+            self.shm.delete(r.id)
+
+    # ==================================================================
+    # task submission
+    # ==================================================================
+    def export_function(self, key: str, data: bytes) -> None:
+        with self._export_lock:
+            if key in self._exported:
+                return
+            self._exported.add(key)
+        # Fire-and-forget is ordered ahead of any submit on the same head
+        # connection, so the function is always visible before dispatch.
+        self.head.send({"kind": "kv_put", "key": key, "value": data})
+
+    def load_function(self, key: str):
+        fn = self._fn_cache.get(key)
+        if fn is not None:
+            return fn
+        for _ in range(100):
+            reply = self.head.request({"kind": "kv_get", "key": key}, timeout=30)
+            if reply["value"] is not None:
+                fn = cloudpickle.loads(reply["value"])
+                self._fn_cache[key] = fn
+                return fn
+            time.sleep(0.05)
+        raise KeyError(f"function {key} not found in GCS")
+
+    def _prepare_args(self, args, kwargs) -> Tuple[List[ArgSpec], Dict[str, ArgSpec]]:
+        def one(v) -> ArgSpec:
+            if isinstance(v, ObjectRef):
+                return ArgSpec(ref=v)
+            meta, buffers, total = serialization.serialize(v)
+            if total > INLINE_OBJECT_MAX:
+                oid = ObjectID.generate()
+                self.shm.create_and_seal(oid, meta, buffers, total)
+                return ArgSpec(ref=ObjectRef(oid, self.addr, total))
+            out = bytearray(total)
+            serialization.write_blob(memoryview(out), meta, buffers)
+            return ArgSpec(data=bytes(out))
+        return [one(a) for a in args], {k: one(v) for k, v in kwargs.items()}
+
+    def submit_task(self, function_key: str, args, kwargs, num_returns=1,
+                    resources=None, max_retries=3, name="") -> List[ObjectRef]:
+        a, kw = self._prepare_args(args, kwargs)
+        spec = TaskSpec(
+            task_id=TaskID.generate(), job_id=self.job_id, kind=NORMAL_TASK,
+            function_key=function_key, args=a, kwargs=kw,
+            num_returns=num_returns,
+            resources=resources if resources is not None else {"CPU": 1.0},
+            caller_addr=self.addr, max_retries=max_retries, name=name)
+        self.head.send({"kind": "submit_task", "spec": spec})
+        return [ObjectRef(oid, self.addr) for oid in spec.return_ids()]
+
+    def create_actor(self, class_key: str, args, kwargs, resources=None,
+                     max_restarts=0, max_concurrency=1, is_asyncio=False,
+                     name="") -> ActorID:
+        a, kw = self._prepare_args(args, kwargs)
+        actor_id = ActorID.generate()
+        spec = TaskSpec(
+            task_id=TaskID.generate(), job_id=self.job_id,
+            kind=ACTOR_CREATION_TASK, function_key=class_key, args=a,
+            kwargs=kw, num_returns=0,
+            resources=resources if resources is not None else {},
+            caller_addr=self.addr, actor_id=actor_id,
+            max_restarts=max_restarts, max_concurrency=max_concurrency,
+            is_asyncio=is_asyncio, name=name)
+        self.head.request({"kind": "create_actor", "spec": spec}, timeout=60)
+        return actor_id
+
+    def submit_actor_task(self, actor_id: ActorID, method_name: str, args,
+                          kwargs, num_returns=1, name="",
+                          timeout: Optional[float] = 120) -> List[ObjectRef]:
+        addr = self.resolve_actor(actor_id, timeout=timeout)
+        a, kw = self._prepare_args(args, kwargs)
+        # Sequence numbers are per (actor incarnation, caller): a restarted
+        # actor gets a fresh stream starting at 0 (reference: the direct
+        # actor submitter resets sequence state on restart).
+        with self._seq_lock:
+            key = (actor_id, addr)
+            seq = self._actor_seqs.get(key, 0)
+            self._actor_seqs[key] = seq + 1
+        spec = TaskSpec(
+            task_id=TaskID.generate(), job_id=self.job_id, kind=ACTOR_TASK,
+            method_name=method_name, args=a, kwargs=kw,
+            num_returns=num_returns, caller_addr=self.addr,
+            actor_id=actor_id, actor_seq=seq, name=name)
+        with self._pending_lock:
+            self._pending_to_addr.setdefault(addr, {})[spec.task_id] = spec
+        try:
+            conn = self._get_conn(addr)
+            conn.send({"kind": "push_task", "spec": spec})
+        except (protocol.ConnectionClosed, FileNotFoundError,
+                ConnectionRefusedError):
+            self._fail_pending_for_addr(addr)
+        return [ObjectRef(oid, self.addr) for oid in spec.return_ids()]
+
+    def resolve_actor(self, actor_id: ActorID, timeout: Optional[float] = 120) -> str:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            info = self._actor_cache.get(actor_id)
+            if info is not None:
+                if info["state"] == "ALIVE":
+                    return info["addr"]
+                if info["state"] == "DEAD":
+                    raise ActorDiedError(actor_id.hex(), info.get("death_reason", ""))
+            ev = self._actor_events.setdefault(actor_id, threading.Event())
+            ev.clear()
+            reply = self.head.request(
+                {"kind": "resolve_actor", "actor_id": actor_id}, timeout=30)
+            info = reply["info"]
+            if info is not None:
+                self._actor_cache[actor_id] = info
+                if info["state"] == "ALIVE":
+                    return info["addr"]
+                if info["state"] == "DEAD":
+                    raise ActorDiedError(actor_id.hex(), info.get("death_reason", ""))
+            # PENDING / RESTARTING / unknown: wait for a publish.
+            rem = 1.0 if deadline is None else min(1.0, deadline - time.monotonic())
+            if rem <= 0:
+                raise GetTimeoutError(
+                    f"actor {actor_id.hex()[:16]} not ready within timeout")
+            ev.wait(rem)
+
+    def kill_actor(self, actor_id: ActorID, no_restart=True):
+        self.head.request({"kind": "kill_actor", "actor_id": actor_id,
+                           "no_restart": no_restart}, timeout=30)
+
+    def get_named_actor(self, name: str) -> Optional[dict]:
+        reply = self.head.request({"kind": "get_named_actor", "name": name},
+                                  timeout=30)
+        return reply["info"]
+
+    def cluster_info(self) -> dict:
+        return self.head.request({"kind": "cluster_info"}, timeout=30)["info"]
+
+    # ==================================================================
+    # connections
+    # ==================================================================
+    def _get_conn(self, addr: str) -> protocol.Connection:
+        inbound = self.server.connections.get(addr)
+        if inbound is not None and not inbound.closed:
+            return inbound
+        with self._conns_lock:
+            conn = self._conns.get(addr)
+            if conn is not None and not conn.closed:
+                return conn
+        conn = protocol.connect(addr, self.addr, self._handle,
+                                on_close=self._on_peer_close)
+        with self._conns_lock:
+            self._conns[addr] = conn
+        return conn
+
+    def _on_peer_close(self, conn: protocol.Connection):
+        with self._conns_lock:
+            if self._conns.get(conn.peer_addr) is conn:
+                del self._conns[conn.peer_addr]
+        self._fail_pending_for_addr(conn.peer_addr)
+
+    def _fail_pending_for_addr(self, addr: str):
+        with self._pending_lock:
+            pending = self._pending_to_addr.pop(addr, {})
+        # Invalidate cached actor locations pointing at the dead addr.
+        for aid, info in list(self._actor_cache.items()):
+            if info.get("addr") == addr:
+                self._actor_cache.pop(aid, None)
+                ev = self._actor_events.get(aid)
+                if ev is not None:
+                    ev.set()
+        for spec in pending.values():
+            err = ActorDiedError(
+                spec.actor_id.hex() if spec.actor_id else "",
+                f"connection to actor lost while {spec.describe()} in flight")
+            for oid in spec.return_ids():
+                self.memory.put(oid, _Cell("error", err))
+
+    def _on_head_close(self, conn):
+        if self.role == "worker" and not self._shutdown_event.is_set():
+            # Head (driver) is gone: exit.
+            os._exit(0)
+
+    # ==================================================================
+    # message handling
+    # ==================================================================
+    def _handle(self, conn: protocol.Connection, msg: dict):
+        kind = msg["kind"]
+        if kind == "push_result":
+            self._on_push_result(msg)
+        elif kind == "get_object":
+            self._on_get_object(conn, msg)
+        elif kind == "execute_task":
+            self._task_queue.put(msg["spec"])
+        elif kind == "push_task":
+            self._on_push_task(msg["spec"])
+        elif kind == "publish":
+            self._on_publish(msg)
+        elif kind == "shutdown":
+            self._shutdown_event.set()
+            os._exit(0)
+        else:
+            logger.warning("runtime: unknown message %s", kind)
+
+    def _on_push_result(self, msg: dict):
+        oid: ObjectID = msg["object_id"]
+        if msg.get("error") is not None:
+            cell = _Cell("error", msg["error"])
+        elif msg.get("in_shm"):
+            cell = _Cell("shm")
+        else:
+            cell = _Cell("raw", msg["data"])
+        self.memory.put(oid, cell)
+        # Clear pending-actor-task tracking.
+        with self._pending_lock:
+            for pending in self._pending_to_addr.values():
+                pending.pop(oid.task_id(), None)
+        # Forward to any borrower that asked before we had it.
+        with self._waiters_lock:
+            waiters = self._object_waiters.pop(oid, ())
+        for addr in waiters:
+            try:
+                self._get_conn(addr).send(msg)
+            except (protocol.ConnectionClosed, FileNotFoundError,
+                    ConnectionRefusedError):
+                pass
+
+    def _on_get_object(self, conn: protocol.Connection, msg: dict):
+        oid: ObjectID = msg["object_id"]
+        entry = self.memory.get_if_exists(oid)
+        if entry is not None:
+            cell: _Cell = entry.value
+            if cell.kind == "raw":
+                conn.reply(msg, status="inline", data=cell.payload)
+            elif cell.kind == "value":
+                try:
+                    data = serialization.dumps(cell.payload)
+                except Exception as e:  # unpicklable cached value
+                    conn.reply(msg, status="lost")
+                    return
+                conn.reply(msg, status="inline", data=data)
+            elif cell.kind == "shm":
+                conn.reply(msg, status="shm")
+            else:  # error — propagate as lost with the error attached
+                conn.reply(msg, status="error", error=cell.payload)
+            return
+        if self.shm.contains(oid):
+            conn.reply(msg, status="shm")
+            return
+        # Not here yet: if we own it (a pending task result), promise a push.
+        with self._waiters_lock:
+            self._object_waiters.setdefault(oid, set()).add(conn.peer_addr)
+        conn.reply(msg, status="pending")
+
+    def _on_publish(self, msg: dict):
+        channel = msg["channel"]
+        if channel.startswith("actor:"):
+            info = msg["data"]
+            aid = info["actor_id"]
+            self._actor_cache[aid] = info
+            ev = self._actor_events.get(aid)
+            if ev is not None:
+                ev.set()
+        elif channel == "error":
+            data = msg["data"]
+            print(f"[ray_tpu] remote error: {data}", flush=True)
+
+    # ==================================================================
+    # execution (worker role)
+    # ==================================================================
+    def _task_loop(self):
+        while not self._shutdown_event.is_set():
+            try:
+                spec = self._task_queue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            if spec.kind == ACTOR_CREATION_TASK:
+                self._execute_actor_creation(spec)
+            else:
+                self._execute_normal(spec)
+
+    def _resolve_args(self, spec: TaskSpec):
+        def one(a: ArgSpec):
+            if a.ref is not None:
+                return self._get_one(a.ref, None)
+            return serialization.loads(a.data, zero_copy=False)
+        args = [one(a) for a in spec.args]
+        kwargs = {k: one(v) for k, v in spec.kwargs.items()}
+        return args, kwargs
+
+    def _push_value(self, addr: str, oid: ObjectID, value=None, error=None):
+        msg = {"kind": "push_result", "object_id": oid}
+        if error is not None:
+            import pickle as _stdpickle
+            try:
+                # The transport frames with stdlib pickle, so probe with it:
+                # locally-defined exception classes must be downgraded to a
+                # plain TaskError carrying the remote traceback.
+                _stdpickle.dumps(error)
+                msg["error"] = error
+            except Exception:
+                msg["error"] = TaskError(None, getattr(error, "remote_tb", ""),
+                                         getattr(error, "task_desc", str(error)))
+        else:
+            try:
+                meta, buffers, total = serialization.serialize(value)
+            except Exception as e:
+                msg["error"] = TaskError.from_exception(e, "serializing result")
+                self._send_result(addr, msg)
+                return
+            if total > INLINE_OBJECT_MAX:
+                self.shm.create_and_seal(oid, meta, buffers, total)
+                msg["in_shm"] = True
+            else:
+                out = bytearray(total)
+                serialization.write_blob(memoryview(out), meta, buffers)
+                msg["data"] = bytes(out)
+        self._send_result(addr, msg)
+
+    def _send_result(self, addr: str, msg: dict):
+        if addr == self.addr:
+            self._on_push_result(msg)
+            return
+        try:
+            self._get_conn(addr).send(msg)
+        except (protocol.ConnectionClosed, FileNotFoundError,
+                ConnectionRefusedError):
+            logger.warning("could not deliver result %s to %s",
+                           msg["object_id"], addr)
+
+    def _execute_one(self, spec: TaskSpec, fn) -> None:
+        try:
+            args, kwargs = self._resolve_args(spec)
+            result = fn(*args, **kwargs)
+            self._deliver_result(spec, result)
+        except SystemExit as e:
+            if spec.kind == ACTOR_TASK:
+                # exit_actor(): fail the in-flight call, then exit cleanly
+                # (reference: `python/ray/actor.py:812` exit_actor).
+                err = ActorDiedError(
+                    spec.actor_id.hex() if spec.actor_id else "",
+                    "actor exited via exit_actor()")
+                for oid in spec.return_ids():
+                    self._push_value(spec.caller_addr, oid, error=err)
+                time.sleep(0.05)
+                os._exit(0)
+            # A normal task calling sys.exit(): report it, keep the worker.
+            err = TaskError(e, "", spec.describe() + " called sys.exit()")
+            for oid in spec.return_ids():
+                self._push_value(spec.caller_addr, oid, error=err)
+        except BaseException as e:  # noqa: BLE001 — report, don't die
+            err = e if isinstance(e, TaskError) else \
+                TaskError.from_exception(e, spec.describe())
+            for oid in spec.return_ids():
+                self._push_value(spec.caller_addr, oid, error=err)
+
+    def _deliver_result(self, spec: TaskSpec, result):
+        n = spec.num_returns
+        if n == 0:
+            return
+        if n == 1:
+            values = [result]
+        else:
+            values = list(result)
+            if len(values) != n:
+                raise TaskError(
+                    ValueError(f"task declared num_returns={n} but returned "
+                               f"{len(values)} values"), "", spec.describe())
+        for oid, val in zip(spec.return_ids(), values):
+            self._push_value(spec.caller_addr, oid, value=val)
+
+    def _execute_normal(self, spec: TaskSpec):
+        try:
+            fn = self.load_function(spec.function_key)
+        except Exception as e:
+            for oid in spec.return_ids():
+                self._push_value(spec.caller_addr, oid,
+                                 error=TaskError.from_exception(e, "loading function"))
+            self.head.send({"kind": "task_done", "task_id": spec.task_id})
+            return
+        self._execute_one(spec, fn)
+        try:
+            self.head.send({"kind": "task_done", "task_id": spec.task_id})
+        except protocol.ConnectionClosed:
+            pass
+
+    def _execute_actor_creation(self, spec: TaskSpec):
+        try:
+            cls = self.load_function(spec.function_key)
+            args, kwargs = self._resolve_args(spec)
+            instance = cls(*args, **kwargs)
+        except BaseException as e:
+            import traceback
+            self.head.send({"kind": "actor_creation_failed",
+                            "actor_id": spec.actor_id,
+                            "error": traceback.format_exc()})
+            time.sleep(0.2)
+            os._exit(1)
+        self._actor = ActorState(spec, instance)
+        self.head.send({"kind": "actor_ready", "actor_id": spec.actor_id,
+                        "addr": self.addr})
+
+    # -- actor tasks -----------------------------------------------------
+    def _on_push_task(self, spec: TaskSpec):
+        actor = self._actor
+        if actor is None:
+            # Creation still in progress; requeue briefly.
+            def later():
+                for _ in range(600):
+                    if self._actor is not None:
+                        self._on_push_task(spec)
+                        return
+                    time.sleep(0.05)
+            threading.Thread(target=later, daemon=True).start()
+            return
+        with actor.lock:
+            stream = actor.streams.setdefault(
+                spec.caller_addr, {"next": 0, "buffer": {}})
+            stream["buffer"][spec.actor_seq] = spec
+            runnable = []
+            while stream["next"] in stream["buffer"]:
+                runnable.append(stream["buffer"].pop(stream["next"]))
+                stream["next"] += 1
+        for s in runnable:
+            self._dispatch_actor_task(actor, s)
+
+    def _dispatch_actor_task(self, actor: ActorState, spec: TaskSpec):
+        if spec.method_name == "__ray_terminate__":
+            def terminate():
+                self._push_value(spec.caller_addr, spec.return_ids()[0], value=None)
+                time.sleep(0.1)
+                os._exit(0)
+            threading.Thread(target=terminate, daemon=True).start()
+            return
+        if actor.loop is not None:
+            asyncio.run_coroutine_threadsafe(
+                self._run_actor_task_async(actor, spec), actor.loop)
+        else:
+            actor.executor.submit(self._run_actor_task, actor, spec)
+
+    def _run_actor_task(self, actor: ActorState, spec: TaskSpec):
+        try:
+            method = getattr(actor.instance, spec.method_name)
+        except AttributeError as e:
+            for oid in spec.return_ids():
+                self._push_value(spec.caller_addr, oid,
+                                 error=TaskError.from_exception(e, spec.describe()))
+            return
+        self._execute_one(spec, method)
+
+    async def _run_actor_task_async(self, actor: ActorState, spec: TaskSpec):
+        async with actor.sem:
+            try:
+                method = getattr(actor.instance, spec.method_name)
+                args, kwargs = self._resolve_args(spec)
+                result = method(*args, **kwargs)
+                if inspect.isawaitable(result):
+                    result = await result
+                self._deliver_result(spec, result)
+            except BaseException as e:
+                err = TaskError.from_exception(e, spec.describe())
+                for oid in spec.return_ids():
+                    self._push_value(spec.caller_addr, oid, error=err)
+
+    # ==================================================================
+    def run_worker_loop(self):
+        """Block until shutdown (worker main)."""
+        self._shutdown_event.wait()
+
+    def shutdown(self):
+        self._shutdown_event.set()
+        try:
+            self.head.close()
+        except Exception:
+            pass
+        self.server.close()
+        with self._conns_lock:
+            conns = list(self._conns.values())
+        # Close outside the lock: each close fires _on_peer_close, which
+        # re-acquires _conns_lock.
+        for c in conns:
+            c.close()
+
+

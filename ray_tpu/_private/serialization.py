@@ -1,0 +1,102 @@
+"""Value serialization: cloudpickle + out-of-band buffers, zero-copy reads.
+
+Parity: the reference's `python/ray/serialization.py` uses cloudpickle with
+pickle-protocol-5 out-of-band buffers backed by arrow, so large numpy arrays
+are written/read without copies. We do the same with a self-contained blob
+format; when the blob lives in the shared-memory store, deserialized numpy
+arrays are zero-copy views over the mmap.
+
+Blob layout (little endian):
+    u32 version | u64 meta_len | meta(cloudpickle bytes)
+    | u32 nbuf | nbuf * (u64 offset, u64 len) | padding | buffer data...
+Buffer offsets are 64-byte aligned (TPU-host DMA friendly).
+"""
+
+from __future__ import annotations
+
+import pickle
+import struct
+from typing import List, Tuple
+
+import cloudpickle
+
+_VERSION = 1
+_HDR = struct.Struct("<IQ")
+_BUFHDR = struct.Struct("<I")
+_BUFENT = struct.Struct("<QQ")
+_ALIGN = 64
+
+
+def _align(n: int) -> int:
+    return (n + _ALIGN - 1) & ~(_ALIGN - 1)
+
+
+def serialize(value) -> Tuple[bytes, List[pickle.PickleBuffer], int]:
+    """Returns (meta, buffers, total_blob_size)."""
+    buffers: List[pickle.PickleBuffer] = []
+    meta = cloudpickle.dumps(value, protocol=5, buffer_callback=buffers.append)
+    # Layout computation.
+    offset = _HDR.size + len(meta) + _BUFHDR.size + _BUFENT.size * len(buffers)
+    total = offset
+    entries = []
+    for buf in buffers:
+        mv = buf.raw()
+        total = _align(total)
+        entries.append((total, mv.nbytes))
+        total += mv.nbytes
+    return meta, buffers, total
+
+
+def write_blob(dst: memoryview, meta: bytes, buffers: List[pickle.PickleBuffer]) -> int:
+    """Write the blob into `dst` (a writable buffer). Returns bytes written."""
+    pos = 0
+    _HDR.pack_into(dst, pos, _VERSION, len(meta))
+    pos += _HDR.size
+    dst[pos:pos + len(meta)] = meta
+    pos += len(meta)
+    _BUFHDR.pack_into(dst, pos, len(buffers))
+    pos += _BUFHDR.size
+    entry_pos = pos
+    pos += _BUFENT.size * len(buffers)
+    for buf in buffers:
+        mv = buf.raw()
+        pos = _align(pos)
+        _BUFENT.pack_into(dst, entry_pos, pos, mv.nbytes)
+        entry_pos += _BUFENT.size
+        if mv.nbytes:
+            dst[pos:pos + mv.nbytes] = mv.cast("B")
+        pos += mv.nbytes
+    return pos
+
+
+def dumps(value) -> bytes:
+    """Serialize to a standalone bytes blob (for inline transport)."""
+    meta, buffers, total = serialize(value)
+    out = bytearray(total)
+    write_blob(memoryview(out), meta, buffers)
+    return bytes(out)
+
+
+def loads(blob, zero_copy: bool = True):
+    """Deserialize a blob (bytes or memoryview).
+
+    With zero_copy=True, returned numpy arrays may alias `blob`'s memory; the
+    caller must keep the backing storage alive (ObjectStore pins it).
+    """
+    mv = memoryview(blob)
+    version, meta_len = _HDR.unpack_from(mv, 0)
+    if version != _VERSION:
+        raise ValueError(f"bad blob version {version}")
+    pos = _HDR.size
+    meta = mv[pos:pos + meta_len]
+    pos += meta_len
+    (nbuf,) = _BUFHDR.unpack_from(mv, pos)
+    pos += _BUFHDR.size
+    bufs = []
+    for i in range(nbuf):
+        off, ln = _BUFENT.unpack_from(mv, pos + i * _BUFENT.size)
+        view = mv[off:off + ln]
+        if not zero_copy:
+            view = bytes(view)
+        bufs.append(pickle.PickleBuffer(view))
+    return pickle.loads(bytes(meta), buffers=bufs)

@@ -1,0 +1,66 @@
+"""Task specifications.
+
+Parity: the reference's `TaskSpecification`
+(`src/ray/common/task/task_spec.h`) — function descriptor, args by value or
+by reference, resource demands, and normal/actor-creation/actor-task
+variants. Ours is a plain picklable dataclass carried over the socket
+transport.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from .ids import ActorID, JobID, ObjectID, TaskID
+
+
+@dataclass
+class ArgSpec:
+    """One task argument: either an inline serialized value or an ObjectRef
+    (reference: `TaskArgByValue` / `TaskArgByReference`)."""
+    data: Optional[bytes] = None  # serialized inline value
+    ref: Optional[object] = None  # ObjectRef (by reference)
+
+
+NORMAL_TASK = 0
+ACTOR_CREATION_TASK = 1
+ACTOR_TASK = 2
+
+
+@dataclass
+class TaskSpec:
+    task_id: TaskID
+    job_id: JobID
+    kind: int = NORMAL_TASK
+    # Key into the GCS function table (normal + creation tasks); actor tasks
+    # instead name a method on the instance.
+    function_key: Optional[str] = None
+    method_name: Optional[str] = None
+    args: List[ArgSpec] = field(default_factory=list)
+    kwargs: Dict[str, ArgSpec] = field(default_factory=dict)
+    num_returns: int = 1
+    resources: Dict[str, float] = field(default_factory=dict)
+    # Advertised server address of the submitting process; it OWNS the result
+    # objects (reference ownership model: the caller's CoreWorker owns
+    # direct-call results).
+    caller_addr: str = ""
+    actor_id: Optional[ActorID] = None
+    # Per (caller, actor) sequence number for ordered actor task streams
+    # (reference: direct_actor_transport.h sequence_number).
+    actor_seq: int = 0
+    max_retries: int = 0
+    retries_used: int = 0
+    # Actor-creation options.
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    is_asyncio: bool = False
+    name: str = ""  # debugging / named actor
+
+    def return_ids(self) -> List[ObjectID]:
+        return [self.task_id.object_id(i) for i in range(self.num_returns)]
+
+    def describe(self) -> str:
+        if self.kind == ACTOR_TASK:
+            return f"{self.name or 'actor'}.{self.method_name}"
+        return self.name or (self.function_key or "?")[:24]

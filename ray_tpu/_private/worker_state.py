@@ -1,0 +1,41 @@
+"""Process-global runtime handle (parity: the global `Worker` object in the
+reference's `python/ray/worker.py:91`)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+SCRIPT_MODE = "driver"
+WORKER_MODE = "worker"
+LOCAL_MODE = "local"
+
+_runtime = None
+_mode: Optional[str] = None
+
+
+def set_runtime(rt, mode: str) -> None:
+    global _runtime, _mode
+    _runtime = rt
+    _mode = mode
+
+
+def get_runtime():
+    if _runtime is None:
+        raise RuntimeError(
+            "ray_tpu has not been initialized; call ray_tpu.init() first "
+            "(inside workers this is automatic).")
+    return _runtime
+
+
+def get_runtime_or_none():
+    return _runtime
+
+
+def mode() -> Optional[str]:
+    return _mode
+
+
+def clear() -> None:
+    global _runtime, _mode
+    _runtime = None
+    _mode = None

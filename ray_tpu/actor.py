@@ -1,0 +1,182 @@
+"""Actor API: `@ray_tpu.remote` classes.
+
+Parity: `python/ray/actor.py` — `ActorClass` (`actor.py:240`), `ActorMethod`
+(`actor.py:53`), `ActorHandle` (`actor.py:524`), `ray.method` num_returns
+metadata, `exit_actor` (`actor.py:812`), named actors, `max_concurrency`,
+asyncio actors, and `max_restarts` fault tolerance.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import inspect
+from typing import Dict, Optional
+
+import cloudpickle
+
+from ._private import worker_state
+from ._private.ids import ActorID
+
+
+def method(num_returns: int = 1):
+    """Decorator to annotate actor methods (reference `ray.method`)."""
+    def wrap(fn):
+        fn.__ray_num_returns__ = num_returns
+        return fn
+    return wrap
+
+
+def exit_actor():
+    """Terminate the current actor from inside one of its methods."""
+    raise SystemExit(0)
+
+
+class ActorMethod:
+    def __init__(self, handle: "ActorHandle", name: str, num_returns: int = 1):
+        self._handle = handle
+        self._name = name
+        self._num_returns = num_returns
+
+    def remote(self, *args, **kwargs):
+        return self._handle._actor_method_call(
+            self._name, args, kwargs, self._num_returns)
+
+    def options(self, num_returns=None):
+        return ActorMethod(self._handle, self._name,
+                           num_returns if num_returns is not None
+                           else self._num_returns)
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor method '{self._name}' cannot be called directly; use "
+            f"'.{self._name}.remote()'.")
+
+
+class ActorHandle:
+    def __init__(self, actor_id: ActorID,
+                 method_num_returns: Optional[Dict[str, int]] = None,
+                 class_name: str = "Actor"):
+        self._actor_id = actor_id
+        self._method_num_returns = method_num_returns or {}
+        self._class_name = class_name
+
+    def _actor_method_call(self, name, args, kwargs, num_returns):
+        rt = worker_state.get_runtime()
+        refs = rt.submit_actor_task(
+            self._actor_id, name, args, kwargs, num_returns=num_returns,
+            name=self._class_name)
+        if num_returns == 0:
+            return None
+        return refs[0] if num_returns == 1 else refs
+
+    def __getattr__(self, name):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return ActorMethod(self, name, self._method_num_returns.get(name, 1))
+
+    def __terminate__(self):
+        return self._actor_method_call("__ray_terminate__", (), {}, 1)
+
+    def __repr__(self):
+        return f"ActorHandle({self._class_name}, {self._actor_id.hex()[:16]})"
+
+    def __reduce__(self):
+        return (ActorHandle,
+                (self._actor_id, self._method_num_returns, self._class_name))
+
+    def __hash__(self):
+        return hash(self._actor_id)
+
+    def __eq__(self, other):
+        return isinstance(other, ActorHandle) and \
+            other._actor_id == self._actor_id
+
+
+class ActorClass:
+    def __init__(self, cls, num_cpus=None, num_tpus=None, resources=None,
+                 max_restarts=0, max_concurrency=None, name=None):
+        self._cls = cls
+        self._class_name = cls.__name__
+        # Reference semantics: actors hold 0 CPU while alive unless asked
+        # (so many lightweight actors can coexist); explicit num_cpus pins.
+        self._resources = {}
+        if num_cpus is not None:
+            self._resources["CPU"] = float(num_cpus)
+        if num_tpus:
+            self._resources["TPU"] = float(num_tpus)
+        if resources:
+            self._resources.update({k: float(v) for k, v in resources.items()})
+        self._max_restarts = max_restarts
+        self._max_concurrency = max_concurrency
+        self._key: Optional[str] = None
+        self._pickled: Optional[bytes] = None
+        self._method_num_returns = {
+            n: getattr(m, "__ray_num_returns__", 1)
+            for n, m in inspect.getmembers(cls, callable)
+            if not n.startswith("__")}
+        self._is_asyncio = any(
+            inspect.iscoroutinefunction(m)
+            for _, m in inspect.getmembers(cls, callable))
+        self.__doc__ = getattr(cls, "__doc__", None)
+
+    def _ensure_exported(self, rt):
+        if self._key is None:
+            self._pickled = cloudpickle.dumps(self._cls, protocol=5)
+            h = hashlib.sha1(self._pickled).hexdigest()[:20]
+            self._key = f"cls:{self._class_name}:{h}"
+        rt.export_function(self._key, self._pickled)
+
+    def remote(self, *args, **kwargs) -> ActorHandle:
+        return self._remote(args, kwargs)
+
+    def _remote(self, args, kwargs, name="", max_concurrency=None,
+                max_restarts=None, num_cpus=None, num_tpus=None,
+                resources=None) -> ActorHandle:
+        rt = worker_state.get_runtime()
+        self._ensure_exported(rt)
+        res = dict(self._resources)
+        if num_cpus is not None:
+            res["CPU"] = float(num_cpus)
+        if num_tpus is not None:
+            res["TPU"] = float(num_tpus)
+        if resources:
+            res.update({k: float(v) for k, v in resources.items()})
+        concurrency = max_concurrency or self._max_concurrency or 1
+        actor_id = rt.create_actor(
+            self._key, args, kwargs, resources=res,
+            max_restarts=max_restarts if max_restarts is not None
+            else self._max_restarts,
+            max_concurrency=concurrency,
+            is_asyncio=self._is_asyncio,
+            name=name)
+        return ActorHandle(actor_id, self._method_num_returns,
+                           self._class_name)
+
+    def options(self, name=None, max_concurrency=None, max_restarts=None,
+                num_cpus=None, num_tpus=None, resources=None):
+        outer = self
+
+        class _Options:
+            def remote(self, *args, **kwargs):
+                return outer._remote(
+                    args, kwargs, name=name or "",
+                    max_concurrency=max_concurrency,
+                    max_restarts=max_restarts, num_cpus=num_cpus,
+                    num_tpus=num_tpus, resources=resources)
+
+        return _Options()
+
+    def __call__(self, *args, **kwargs):
+        raise TypeError(
+            f"Actor class '{self._class_name}' cannot be instantiated "
+            f"directly; use '{self._class_name}.remote()'.")
+
+
+def get_actor(name: str) -> ActorHandle:
+    """Look up a named actor (reference: `ray.util.get_actor` /
+    `experimental/named_actors.py`)."""
+    rt = worker_state.get_runtime()
+    info = rt.get_named_actor(name)
+    if info is None or info["state"] == "DEAD":
+        raise ValueError(f"no live actor named {name!r}")
+    return ActorHandle(info["actor_id"], class_name=info.get("name") or name)

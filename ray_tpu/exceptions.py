@@ -1,0 +1,81 @@
+"""Public exception types.
+
+Parity with the reference's `python/ray/exceptions.py`: RayError,
+RayTaskError (user exception wrapped with remote traceback), RayActorError,
+WorkerCrashedError, ObjectLostError, GetTimeoutError.
+"""
+
+from __future__ import annotations
+
+import traceback
+
+
+class RayError(Exception):
+    """Base class for all framework errors."""
+
+
+class TaskError(RayError):
+    """A task raised an exception during execution.
+
+    Wraps the user exception; re-raised on `ray_tpu.get` of the task's
+    result, with the remote traceback embedded in the message (same UX as the
+    reference's `RayTaskError`, `python/ray/exceptions.py`).
+    """
+
+    def __init__(self, cause: BaseException = None, remote_tb: str = "",
+                 task_desc: str = ""):
+        self.cause = cause
+        self.remote_tb = remote_tb
+        self.task_desc = task_desc
+        msg = f"task {task_desc} failed"
+        if cause is not None:
+            msg += f": {type(cause).__name__}: {cause}"
+        if remote_tb:
+            msg += "\n\n--- remote traceback ---\n" + remote_tb
+        super().__init__(msg)
+
+    @classmethod
+    def from_exception(cls, e: BaseException, task_desc: str = ""):
+        return cls(e, traceback.format_exc(), task_desc)
+
+
+# Alias matching the reference name.
+RayTaskError = TaskError
+
+
+class WorkerCrashedError(RayError):
+    """The worker process executing the task died unexpectedly."""
+
+
+class ActorError(RayError):
+    """Base for actor-related failures."""
+
+
+class ActorDiedError(ActorError):
+    """The actor is dead: its process exited (or creation failed) and no
+    restarts remain."""
+
+    def __init__(self, actor_id_hex: str = "", reason: str = ""):
+        self.actor_id_hex = actor_id_hex
+        self.reason = reason
+        super().__init__(
+            f"actor {actor_id_hex[:16]} died" + (f": {reason}" if reason else ""))
+
+
+RayActorError = ActorDiedError
+
+
+class ActorUnavailableError(ActorError):
+    """The actor is restarting; the call may be retried."""
+
+
+class ObjectLostError(RayError):
+    """The object's value was lost (owner died or store evicted it)."""
+
+
+class GetTimeoutError(RayError, TimeoutError):
+    """`ray_tpu.get(..., timeout=)` expired."""
+
+
+class RuntimeShutdownError(RayError):
+    """Operation attempted on a shut-down runtime."""

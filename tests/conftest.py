@@ -1,0 +1,45 @@
+"""Test configuration.
+
+Forces JAX onto a virtual 8-device CPU platform (mirroring the reference's
+in-process multi-node `cluster_utils.Cluster` trick, SURVEY.md §4.2: fake
+topology so collective code runs in CI without real hardware).
+"""
+
+import os
+import sys
+
+# Must happen before jax initializes its backend.
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["JAX_ENABLE_X64"] = "0"
+# Neutralize the axon TPU plugin if its sitecustomize already ran.
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+try:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+except Exception:
+    pass
+
+import pytest
+
+
+@pytest.fixture
+def ray_start():
+    """Boot a real multi-process runtime for a test, like the reference's
+    `ray_start_regular` fixture (`python/ray/tests/conftest.py`)."""
+    import ray_tpu
+    ray_tpu.init(num_cpus=4)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+@pytest.fixture
+def ray_local():
+    import ray_tpu
+    ray_tpu.init(local_mode=True)
+    yield ray_tpu
+    ray_tpu.shutdown()

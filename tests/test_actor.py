@@ -1,0 +1,269 @@
+"""Actor API tests (parity: reference `python/ray/tests/test_actor.py`)."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_counter(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Counter:
+        def __init__(self, start=0):
+            self.n = start
+
+        def inc(self, by=1):
+            self.n += by
+            return self.n
+
+        def value(self):
+            return self.n
+
+    c = Counter.remote(10)
+    assert ray.get(c.inc.remote()) == 11
+    assert ray.get(c.inc.remote(5)) == 16
+    assert ray.get(c.value.remote()) == 16
+
+
+def test_actor_ordering(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Appender:
+        def __init__(self):
+            self.items = []
+
+        def add(self, x):
+            self.items.append(x)
+            return len(self.items)
+
+        def get_items(self):
+            return self.items
+
+    a = Appender.remote()
+    for i in range(50):
+        a.add.remote(i)
+    assert ray.get(a.get_items.remote()) == list(range(50))
+
+
+def test_actor_method_error(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Bad:
+        def boom(self):
+            raise RuntimeError("actor kaboom")
+
+        def fine(self):
+            return "ok"
+
+    b = Bad.remote()
+    with pytest.raises(ray.TaskError, match="actor kaboom"):
+        ray.get(b.boom.remote())
+    # Actor survives method errors.
+    assert ray.get(b.fine.remote()) == "ok"
+
+
+def test_actor_creation_error(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Broken:
+        def __init__(self):
+            raise ValueError("cannot construct")
+
+        def m(self):
+            return 1
+
+    b = Broken.remote()
+    with pytest.raises(ray.ActorDiedError):
+        ray.get(b.m.remote())
+
+
+def test_two_actors_parallel(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Sleeper:
+        def nap(self, t):
+            time.sleep(t)
+            return t
+
+    a, b = Sleeper.remote(), Sleeper.remote()
+    t0 = time.time()
+    refs = [a.nap.remote(1.0), b.nap.remote(1.0)]
+    assert ray.get(refs) == [1.0, 1.0]
+    assert time.time() - t0 < 1.9  # ran concurrently
+
+
+def test_pass_handle_to_task(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    @ray.remote
+    def bump(counter):
+        import ray_tpu
+        return ray_tpu.get(counter.inc.remote())
+
+    c = Counter.remote()
+    assert sorted(ray.get([bump.remote(c) for _ in range(3)])) == [1, 2, 3]
+
+
+def test_named_actor(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Store:
+        def __init__(self):
+            self.v = None
+
+        def set(self, v):
+            self.v = v
+
+        def get_value(self):
+            return self.v
+
+    Store.options(name="kv_store").remote()
+    h = ray.get_actor("kv_store")
+    ray.get(h.set.remote(123))
+    assert ray.get(h.get_value.remote()) == 123
+
+
+def test_max_concurrency(ray_start):
+    ray = ray_start
+
+    @ray.remote(max_concurrency=4)
+    class Parallel:
+        def nap(self):
+            time.sleep(0.8)
+            return 1
+
+    p = Parallel.remote()
+    t0 = time.time()
+    assert sum(ray.get([p.nap.remote() for _ in range(4)])) == 4
+    assert time.time() - t0 < 2.5
+
+
+def test_asyncio_actor(ray_start):
+    ray = ray_start
+
+    @ray.remote(max_concurrency=8)
+    class AsyncWorker:
+        async def work(self, t):
+            import asyncio
+            await asyncio.sleep(t)
+            return t
+
+    w = AsyncWorker.remote()
+    t0 = time.time()
+    out = ray.get([w.work.remote(0.8) for _ in range(8)])
+    assert out == [0.8] * 8
+    assert time.time() - t0 < 3.0
+
+
+def test_kill_actor(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Victim:
+        def ping(self):
+            return "pong"
+
+    v = Victim.remote()
+    assert ray.get(v.ping.remote()) == "pong"
+    ray.kill(v)
+    time.sleep(0.5)
+    with pytest.raises((ray.ActorDiedError, ray.GetTimeoutError)):
+        ray.get(v.ping.remote(), timeout=10)
+
+
+def test_actor_restart(ray_start):
+    ray = ray_start
+
+    @ray.remote(max_restarts=1)
+    class Phoenix:
+        def __init__(self):
+            self.state = 0
+
+        def set_state(self, v):
+            self.state = v
+
+        def get_state(self):
+            return self.state
+
+        def die(self):
+            import os
+            os._exit(1)
+
+    p = Phoenix.remote()
+    ray.get(p.set_state.remote(42))
+    p.die.remote()
+    time.sleep(1.0)
+    # After restart, state is fresh (creation task replayed).
+    deadline = time.time() + 30
+    while True:
+        try:
+            assert ray.get(p.get_state.remote(), timeout=30) == 0
+            break
+        except ray.ActorDiedError:
+            if time.time() > deadline:
+                raise
+            time.sleep(0.2)
+
+
+def test_actor_large_payload(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Echo:
+        def echo(self, x):
+            return x
+
+    e = Echo.remote()
+    arr = np.random.rand(1 << 17)
+    np.testing.assert_array_equal(ray.get(e.echo.remote(arr)), arr)
+
+
+def test_exit_actor(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    class Quitter:
+        def quit(self):
+            import ray_tpu
+            ray_tpu.exit_actor()
+
+        def ping(self):
+            return "pong"
+
+    q = Quitter.remote()
+    assert ray.get(q.ping.remote()) == "pong"
+    with pytest.raises(ray.ActorDiedError):
+        ray.get(q.quit.remote())
+
+
+def test_local_mode_actor(ray_local):
+    ray = ray_local
+
+    @ray.remote
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def inc(self):
+            self.n += 1
+            return self.n
+
+    c = Counter.remote()
+    assert ray.get(c.inc.remote()) == 1
+    assert ray.get(c.inc.remote()) == 2

@@ -1,0 +1,174 @@
+"""Core task/object API tests (parity: reference `python/ray/tests/test_basic.py`)."""
+
+import time
+
+import numpy as np
+import pytest
+
+
+def test_put_get(ray_start):
+    ray = ray_start
+    ref = ray.put(42)
+    assert ray.get(ref) == 42
+    ref2 = ray.put({"a": [1, 2, 3], "b": "x"})
+    assert ray.get(ref2) == {"a": [1, 2, 3], "b": "x"}
+
+
+def test_put_get_numpy_zero_copy(ray_start):
+    ray = ray_start
+    arr = np.arange(100_000, dtype=np.float32)
+    ref = ray.put(arr)
+    out = ray.get(ref)
+    np.testing.assert_array_equal(arr, out)
+    # Zero-copy reads come back read-only (backed by the shm mapping).
+    assert not out.flags.writeable
+
+
+def test_simple_task(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def f(x):
+        return x * 2
+
+    assert ray.get(f.remote(21)) == 42
+
+
+def test_task_fanout(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def f(x):
+        return x + 1
+
+    refs = [f.remote(i) for i in range(20)]
+    assert ray.get(refs) == list(range(1, 21))
+
+
+def test_task_args_by_ref(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def add(a, b):
+        return a + b
+
+    x = ray.put(10)
+    y = add.remote(x, 5)
+    z = add.remote(y, ray.put(1))
+    assert ray.get(z) == 16
+
+
+def test_large_args_and_results(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def echo(a):
+        return a
+
+    big = np.random.rand(1 << 18)  # 2 MiB, forces shm path
+    out = ray.get(echo.remote(big))
+    np.testing.assert_array_equal(big, out)
+
+
+def test_multiple_returns(ray_start):
+    ray = ray_start
+
+    @ray.remote(num_returns=3)
+    def three():
+        return 1, 2, 3
+
+    a, b, c = three.remote()
+    assert ray.get([a, b, c]) == [1, 2, 3]
+
+
+def test_task_error_propagates(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def boom():
+        raise ValueError("kaboom")
+
+    with pytest.raises(ray.TaskError, match="kaboom"):
+        ray.get(boom.remote())
+
+
+def test_nested_tasks(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def inner(x):
+        return x + 1
+
+    @ray.remote
+    def outer(x):
+        import ray_tpu
+        return ray_tpu.get(inner.remote(x)) + 100
+
+    assert ray.get(outer.remote(1)) == 102
+
+
+def test_wait(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def fast():
+        return "fast"
+
+    @ray.remote
+    def slow():
+        time.sleep(5)
+        return "slow"
+
+    r_fast, r_slow = fast.remote(), slow.remote()
+    ready, not_ready = ray.wait([r_fast, r_slow], num_returns=1, timeout=3)
+    assert ready == [r_fast]
+    assert not_ready == [r_slow]
+
+
+def test_get_timeout(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def sleepy():
+        time.sleep(10)
+
+    with pytest.raises(ray.GetTimeoutError):
+        ray.get(sleepy.remote(), timeout=0.5)
+
+
+def test_options_override(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def f():
+        return 7
+
+    assert ray.get(f.options(num_cpus=2).remote()) == 7
+
+
+def test_cluster_resources(ray_start):
+    ray = ray_start
+    assert ray.cluster_resources()["CPU"] == 4.0
+
+
+def test_cannot_call_remote_directly(ray_start):
+    ray = ray_start
+
+    @ray.remote
+    def f():
+        return 1
+
+    with pytest.raises(TypeError):
+        f()
+
+
+def test_local_mode(ray_local):
+    ray = ray_local
+
+    @ray.remote
+    def f(x):
+        return x * 3
+
+    assert ray.get(f.remote(3)) == 9
+    ref = ray.put("v")
+    assert ray.get(ref) == "v"
