@@ -338,7 +338,19 @@ class HeadServer:
                 info = self._actors.get(spec.actor_id)
                 if info is None:
                     continue
-                w = self._spawn_worker(dedicated=True)
+                try:
+                    w = self._spawn_worker(dedicated=True,
+                                           extra_env=spec.env_vars)
+                except Exception as e:
+                    # A bad spawn (e.g. unpicklable env) must not abort the
+                    # drain loop and strand other queued tasks.
+                    logger.exception("failed to spawn actor worker")
+                    info.state = DEAD
+                    info.death_reason = f"worker spawn failed: {e}"
+                    self._release_actor_name_locked(info)
+                    self._publish("actor:" + spec.actor_id.hex(),
+                                  info.view())
+                    continue
                 w.actor_id = spec.actor_id
                 w.current_task = spec
                 info.worker_pid = w.proc.pid
@@ -380,9 +392,12 @@ class HeadServer:
             except protocol.ConnectionClosed:
                 pass
 
-    def _spawn_worker(self, dedicated: bool) -> WorkerInfo:
+    def _spawn_worker(self, dedicated: bool,
+                      extra_env: Optional[dict] = None) -> WorkerInfo:
         env = dict(os.environ)
         env.update(self.worker_env)
+        if extra_env:
+            env.update(extra_env)
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_SESSION_NAME"] = self.session_name
         # Workers must see the same import universe as the driver (parity:

@@ -87,7 +87,13 @@ class LocalRuntime:
     # -- actors ----------------------------------------------------------
     def create_actor(self, class_key, args, kwargs, resources=None,
                      max_restarts=0, max_concurrency=1, is_asyncio=False,
-                     name="") -> ActorID:
+                     name="", env_vars=None) -> ActorID:
+        if env_vars:
+            import logging
+            logging.getLogger(__name__).warning(
+                "local_mode ignores env_vars=%s (no worker process is "
+                "spawned); behavior may differ from cluster mode",
+                sorted(env_vars))
         cls = self._functions[class_key]
         a, kw = self._resolve(args, kwargs)
         actor_id = ActorID.generate()

@@ -315,7 +315,7 @@ class Runtime:
 
     def create_actor(self, class_key: str, args, kwargs, resources=None,
                      max_restarts=0, max_concurrency=1, is_asyncio=False,
-                     name="") -> ActorID:
+                     name="", env_vars=None) -> ActorID:
         a, kw = self._prepare_args(args, kwargs)
         actor_id = ActorID.generate()
         spec = TaskSpec(
@@ -325,7 +325,8 @@ class Runtime:
             resources=resources if resources is not None else {},
             caller_addr=self.addr, actor_id=actor_id,
             max_restarts=max_restarts, max_concurrency=max_concurrency,
-            is_asyncio=is_asyncio, name=name)
+            is_asyncio=is_asyncio, name=name,
+            env_vars={str(k): str(v) for k, v in (env_vars or {}).items()})
         self.head.request({"kind": "create_actor", "spec": spec}, timeout=60)
         return actor_id
 

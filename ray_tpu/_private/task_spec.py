@@ -56,6 +56,9 @@ class TaskSpec:
     max_concurrency: int = 1
     is_asyncio: bool = False
     name: str = ""  # debugging / named actor
+    # Extra environment variables for the (dedicated) worker process —
+    # e.g. rollout actors force JAX onto CPU while the learner keeps the TPU.
+    env_vars: Dict[str, str] = field(default_factory=dict)
 
     def return_ids(self) -> List[ObjectID]:
         return [self.task_id.object_id(i) for i in range(self.num_returns)]

@@ -131,7 +131,7 @@ class ActorClass:
 
     def _remote(self, args, kwargs, name="", max_concurrency=None,
                 max_restarts=None, num_cpus=None, num_tpus=None,
-                resources=None) -> ActorHandle:
+                resources=None, env_vars=None) -> ActorHandle:
         rt = worker_state.get_runtime()
         self._ensure_exported(rt)
         res = dict(self._resources)
@@ -148,12 +148,12 @@ class ActorClass:
             else self._max_restarts,
             max_concurrency=concurrency,
             is_asyncio=self._is_asyncio,
-            name=name)
+            name=name, env_vars=env_vars)
         return ActorHandle(actor_id, self._method_num_returns,
                            self._class_name)
 
     def options(self, name=None, max_concurrency=None, max_restarts=None,
-                num_cpus=None, num_tpus=None, resources=None):
+                num_cpus=None, num_tpus=None, resources=None, env_vars=None):
         outer = self
 
         class _Options:
@@ -162,7 +162,8 @@ class ActorClass:
                     args, kwargs, name=name or "",
                     max_concurrency=max_concurrency,
                     max_restarts=max_restarts, num_cpus=num_cpus,
-                    num_tpus=num_tpus, resources=resources)
+                    num_tpus=num_tpus, resources=resources,
+                    env_vars=env_vars)
 
         return _Options()
 

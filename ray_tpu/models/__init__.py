@@ -1,0 +1,4 @@
+from .catalog import MODEL_DEFAULTS, get_model, get_preprocessor  # noqa: F401
+from .distributions import (Categorical, Deterministic, DiagGaussian,  # noqa: F401
+                            SquashedGaussian, get_action_dist)
+from .networks import FullyConnectedNetwork, LSTMNetwork, VisionNetwork  # noqa: F401

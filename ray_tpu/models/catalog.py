@@ -1,0 +1,94 @@
+"""ModelCatalog: spaces → preprocessors, networks, action distributions.
+
+Parity: `rllib/models/catalog.py` (`get_action_dist`:109, `get_model_v2`:254,
+`get_preprocessor`:358) with the same MODEL_DEFAULTS vocabulary
+(fcnet_hiddens, conv_filters, use_lstm, ...).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from ..rllib.env.spaces import Box, Discrete
+from .distributions import get_action_dist  # re-export  # noqa: F401
+from .networks import FullyConnectedNetwork, LSTMNetwork, VisionNetwork
+
+MODEL_DEFAULTS = {
+    "fcnet_hiddens": [256, 256],
+    "fcnet_activation": "tanh",
+    "conv_filters": None,  # None -> nature CNN for image obs
+    "vf_share_layers": False,
+    "free_log_std": False,
+    "use_lstm": False,
+    "lstm_cell_size": 256,
+    "max_seq_len": 20,
+    "framework": "jax",
+}
+
+
+class Preprocessor:
+    """obs → flat/typed numpy (parity: `rllib/models/preprocessors.py`).
+
+    Kept deliberately thin: images pass through as uint8 (normalized
+    on-device in the network, so host→device stays 1 byte/pixel), Discrete
+    becomes one-hot, Box passes through.
+    """
+
+    def __init__(self, obs_space):
+        self.obs_space = obs_space
+        if isinstance(obs_space, Discrete):
+            self.shape = (obs_space.n,)
+            self.dtype = np.float32
+        else:
+            self.shape = obs_space.shape
+            self.dtype = obs_space.dtype if hasattr(obs_space, "dtype") \
+                else np.float32
+
+    def transform(self, obs):
+        if isinstance(self.obs_space, Discrete):
+            out = np.zeros(self.obs_space.n, dtype=np.float32)
+            out[int(obs)] = 1.0
+            return out
+        return np.asarray(obs, dtype=self.dtype)
+
+
+def get_preprocessor(obs_space) -> Preprocessor:
+    return Preprocessor(obs_space)
+
+
+def is_image_space(obs_space) -> bool:
+    return isinstance(obs_space, Box) and len(obs_space.shape) == 3
+
+
+def get_model(obs_space, num_outputs: int, model_config: dict = None):
+    """Build the flax module for this observation space.
+
+    Returns a module whose __call__(obs) -> (dist_inputs, value).
+    """
+    cfg = dict(MODEL_DEFAULTS)
+    cfg.update(model_config or {})
+    if cfg["use_lstm"]:
+        return LSTMNetwork(
+            num_outputs=num_outputs,
+            cell_size=cfg["lstm_cell_size"],
+            hiddens=tuple(cfg["fcnet_hiddens"][:1]) or (256,),
+            activation=cfg["fcnet_activation"])
+    if is_image_space(obs_space):
+        filters = cfg["conv_filters"] or ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+        return VisionNetwork(
+            num_outputs=num_outputs,
+            conv_filters=tuple(tuple(f) for f in filters))
+    return FullyConnectedNetwork(
+        num_outputs=num_outputs,
+        hiddens=tuple(cfg["fcnet_hiddens"]),
+        activation=cfg["fcnet_activation"],
+        vf_share_layers=cfg["vf_share_layers"],
+        free_log_std=cfg["free_log_std"])
+
+
+def observation_shape(obs_space) -> Tuple[int, ...]:
+    if isinstance(obs_space, Discrete):
+        return (obs_space.n,)
+    return tuple(obs_space.shape)

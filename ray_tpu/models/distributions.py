@@ -1,0 +1,188 @@
+"""Action distributions as pure JAX functions of `dist_inputs`.
+
+Parity: `rllib/models/tf/tf_action_dist.py` (Categorical, DiagGaussian,
+Deterministic) — but stateless and jit-friendly: every method is traceable,
+so the whole (model forward → sample → logp) pipeline compiles into one XLA
+program for both rollout inference and the learner loss.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..rllib.env.spaces import Box, Discrete, MultiDiscrete
+
+
+class Distribution:
+    def __init__(self, inputs):
+        self.inputs = inputs
+
+    def sample(self, rng):
+        raise NotImplementedError
+
+    def deterministic_sample(self):
+        raise NotImplementedError
+
+    def logp(self, x):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl(self, other):
+        raise NotImplementedError
+
+    @staticmethod
+    def required_input_dim(space) -> int:
+        raise NotImplementedError
+
+
+class Categorical(Distribution):
+    """inputs: logits (..., n)."""
+
+    def sample(self, rng):
+        return jax.random.categorical(rng, self.inputs, axis=-1)
+
+    def deterministic_sample(self):
+        return jnp.argmax(self.inputs, axis=-1)
+
+    def logp(self, x):
+        logits = jax.nn.log_softmax(self.inputs)
+        return jnp.take_along_axis(
+            logits, x[..., None].astype(jnp.int32), axis=-1)[..., 0]
+
+    def entropy(self):
+        logp = jax.nn.log_softmax(self.inputs)
+        return -jnp.sum(jnp.exp(logp) * logp, axis=-1)
+
+    def kl(self, other: "Categorical"):
+        logp = jax.nn.log_softmax(self.inputs)
+        logq = jax.nn.log_softmax(other.inputs)
+        return jnp.sum(jnp.exp(logp) * (logp - logq), axis=-1)
+
+    @staticmethod
+    def required_input_dim(space) -> int:
+        return space.n
+
+
+class DiagGaussian(Distribution):
+    """inputs: concat([mean, log_std], -1) over a Box of dim d."""
+
+    def __init__(self, inputs):
+        super().__init__(inputs)
+        self.mean, self.log_std = jnp.split(inputs, 2, axis=-1)
+        self.std = jnp.exp(self.log_std)
+
+    def sample(self, rng):
+        return self.mean + self.std * jax.random.normal(
+            rng, self.mean.shape, dtype=self.mean.dtype)
+
+    def deterministic_sample(self):
+        return self.mean
+
+    def logp(self, x):
+        d = self.mean.shape[-1]
+        return (-0.5 * jnp.sum(((x - self.mean) / self.std) ** 2, axis=-1)
+                - 0.5 * d * jnp.log(2 * jnp.pi)
+                - jnp.sum(self.log_std, axis=-1))
+
+    def entropy(self):
+        d = self.mean.shape[-1]
+        return jnp.sum(self.log_std, axis=-1) + \
+            0.5 * d * (1.0 + jnp.log(2 * jnp.pi))
+
+    def kl(self, other: "DiagGaussian"):
+        return jnp.sum(
+            other.log_std - self.log_std
+            + (self.std ** 2 + (self.mean - other.mean) ** 2)
+            / (2.0 * other.std ** 2) - 0.5, axis=-1)
+
+    @staticmethod
+    def required_input_dim(space) -> int:
+        return 2 * int(np.prod(space.shape))
+
+
+class Deterministic(Distribution):
+    """Pass-through (DDPG-style policies)."""
+
+    def sample(self, rng):
+        return self.inputs
+
+    def deterministic_sample(self):
+        return self.inputs
+
+    def logp(self, x):
+        return jnp.zeros(self.inputs.shape[:-1], self.inputs.dtype)
+
+    def entropy(self):
+        return jnp.zeros(self.inputs.shape[:-1], self.inputs.dtype)
+
+    def kl(self, other):
+        return jnp.zeros(self.inputs.shape[:-1], self.inputs.dtype)
+
+    @staticmethod
+    def required_input_dim(space) -> int:
+        return int(np.prod(space.shape))
+
+
+class SquashedGaussian(Distribution):
+    """tanh-squashed gaussian bounded to a Box (SAC policies)."""
+
+    def __init__(self, inputs, low=-1.0, high=1.0):
+        super().__init__(inputs)
+        self.mean, log_std = jnp.split(inputs, 2, axis=-1)
+        self.log_std = jnp.clip(log_std, -20.0, 2.0)
+        self.std = jnp.exp(self.log_std)
+        self.low, self.high = low, high
+
+    def _squash(self, raw):
+        return self.low + (jnp.tanh(raw) + 1.0) * (self.high - self.low) / 2.0
+
+    def _unsquash(self, x):
+        y = 2.0 * (x - self.low) / (self.high - self.low) - 1.0
+        y = jnp.clip(y, -1.0 + 1e-6, 1.0 - 1e-6)
+        return jnp.arctanh(y)
+
+    def sample(self, rng):
+        raw = self.mean + self.std * jax.random.normal(
+            rng, self.mean.shape, dtype=self.mean.dtype)
+        return self._squash(raw)
+
+    def deterministic_sample(self):
+        return self._squash(self.mean)
+
+    def logp(self, x):
+        raw = self._unsquash(x)
+        d = self.mean.shape[-1]
+        base = (-0.5 * jnp.sum(((raw - self.mean) / self.std) ** 2, axis=-1)
+                - 0.5 * d * jnp.log(2 * jnp.pi)
+                - jnp.sum(self.log_std, axis=-1))
+        # log|d squash / d raw|
+        correction = jnp.sum(
+            jnp.log((1 - jnp.tanh(raw) ** 2) * (self.high - self.low) / 2.0
+                    + 1e-8), axis=-1)
+        return base - correction
+
+    def entropy(self):
+        # No closed form; estimate with the unsquashed entropy (standard).
+        d = self.mean.shape[-1]
+        return jnp.sum(self.log_std, axis=-1) + \
+            0.5 * d * (1.0 + jnp.log(2 * jnp.pi))
+
+    @staticmethod
+    def required_input_dim(space) -> int:
+        return 2 * int(np.prod(space.shape))
+
+
+def get_action_dist(action_space):
+    """Map a space to (dist_class, required_input_dim) — parity:
+    `ModelCatalog.get_action_dist` (`rllib/models/catalog.py:109`)."""
+    if isinstance(action_space, Discrete):
+        return Categorical, action_space.n
+    if isinstance(action_space, Box):
+        return DiagGaussian, DiagGaussian.required_input_dim(action_space)
+    if isinstance(action_space, MultiDiscrete):
+        raise NotImplementedError("MultiDiscrete dist: use a Tuple policy")
+    raise ValueError(f"unsupported action space {action_space}")

@@ -1,0 +1,72 @@
+"""Device-mesh helpers: the TPU replacement for the reference's device
+placement machinery.
+
+Where the reference pinned TF towers to `/gpu:N` and averaged gradients
+in-graph (`rllib/optimizers/multi_gpu_impl.py:83-93,310`), here the learner
+is ONE jitted program over a `jax.sharding.Mesh`: parameters replicated,
+batches sharded along the `dp` axis, and XLA inserts the gradient psum over
+ICI. The same program runs on 1 chip (trivial mesh) or a pod slice.
+
+Axis vocabulary (used by parallel/learner.py and the policies):
+- "dp": data parallel (batch dim)
+- "mp": model/tensor parallel (large dense layers, optional)
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def get_devices(platform: Optional[str] = None):
+    devs = jax.devices()
+    if platform:
+        devs = [d for d in devs if d.platform == platform]
+    return devs
+
+
+def make_mesh(num_devices: Optional[int] = None,
+              axis_names: Sequence[str] = ("dp",),
+              shape: Optional[Sequence[int]] = None,
+              devices=None) -> Mesh:
+    """Build a mesh over the local devices.
+
+    With only `num_devices`, makes a 1-D "dp" mesh. With `shape`,
+    reshapes devices to that topology (e.g. (4, 2) for ("dp", "mp")).
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    if num_devices is not None:
+        devs = devs[:num_devices]
+    if shape is None:
+        shape = (len(devs),) if len(axis_names) == 1 else None
+        if shape is None:
+            raise ValueError("shape required for multi-axis meshes")
+    arr = np.array(devs).reshape(tuple(shape))
+    return Mesh(arr, tuple(axis_names))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharded(mesh: Mesh, axis: str = "dp") -> NamedSharding:
+    return NamedSharding(mesh, P(axis))
+
+
+def put_replicated(tree, mesh: Mesh):
+    sharding = replicated(mesh)
+    return jax.device_put(tree, sharding)
+
+
+def put_batch(tree, mesh: Mesh, axis: str = "dp"):
+    sharding = batch_sharded(mesh, axis)
+    return jax.device_put(tree, sharding)
+
+
+def pad_to_multiple(batch_size: int, n: int) -> int:
+    """Smallest multiple of n >= batch_size (batches must divide the dp
+    axis evenly for even sharding)."""
+    return ((batch_size + n - 1) // n) * n

@@ -1,0 +1,6 @@
+"""RL library: policies, rollout workers, optimizers, algorithms.
+
+Parity scope: the reference's `rllib/` (SURVEY.md §2.3), re-architected for
+TPU: a single JAX policy stack, mesh-resident learners, XLA collectives.
+"""
+from .sample_batch import SampleBatch, MultiAgentBatch  # noqa: F401

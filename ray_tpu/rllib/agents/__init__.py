@@ -1,0 +1,3 @@
+from .registry import get_trainer_class  # noqa: F401
+from .trainer import COMMON_CONFIG, Trainer, with_common_config  # noqa: F401
+from .trainer_template import build_trainer  # noqa: F401

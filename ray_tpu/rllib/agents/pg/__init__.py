@@ -1,0 +1,1 @@
+from .pg import DEFAULT_CONFIG, PGJaxPolicy, PGTrainer  # noqa: F401

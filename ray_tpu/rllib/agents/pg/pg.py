@@ -1,0 +1,47 @@
+"""Vanilla policy gradient.
+
+Parity: `rllib/agents/pg/` — REINFORCE on discounted returns; the simplest
+algorithm and the plumbing smoke test.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import sample_batch as sb
+from ...evaluation.postprocessing import compute_advantages
+from ...policy.jax_policy_template import build_jax_policy
+from ..trainer import with_common_config
+from ..trainer_template import build_trainer
+
+DEFAULT_CONFIG = with_common_config({
+    "lr": 0.0004,
+    "use_gae": False,
+    "use_critic": False,
+    "train_batch_size": 200,
+})
+
+
+def pg_loss(policy, params, batch, rng, loss_state):
+    dist_inputs, _ = policy.apply(params, batch[sb.OBS])
+    dist = policy.dist_class(dist_inputs)
+    logp = dist.logp(batch[sb.ACTIONS])
+    adv = batch[sb.ADVANTAGES]
+    # Standardize returns within the batch: keeps the gradient scale
+    # independent of episode length/reward magnitude.
+    adv = (adv - jnp.mean(adv)) / (jnp.std(adv) + 1e-8)
+    loss = -jnp.mean(logp * adv)
+    return loss, {"policy_loss": loss, "entropy": jnp.mean(dist.entropy())}
+
+
+def pg_postprocess(policy, batch, other_agent_batches=None, episode=None):
+    return batch  # advantages already computed by the worker postprocess
+
+
+PGJaxPolicy = build_jax_policy(
+    "PGJaxPolicy", pg_loss, get_default_config=lambda: DEFAULT_CONFIG)
+
+PGTrainer = build_trainer(
+    name="PG",
+    default_policy=PGJaxPolicy,
+    default_config=DEFAULT_CONFIG)

@@ -1,0 +1,1 @@
+from .ppo import DEFAULT_CONFIG, PPOJaxPolicy, PPOTrainer  # noqa: F401

@@ -1,0 +1,132 @@
+"""Proximal Policy Optimization.
+
+Parity: `rllib/agents/ppo/ppo.py` (+ `ppo_policy.py`) — clipped surrogate +
+clipped value loss + entropy bonus + adaptive KL penalty
+(`update_kl` hook), GAE postprocessing, minibatch SGD.
+
+TPU re-architecture: the minibatch-SGD phase
+(`choose_policy_optimizer` → `LocalMultiGPUOptimizer`, ppo.py:77,113) is
+replaced by `MultiDeviceOptimizer` → `JaxPolicy.sgd_learn`: one donated
+XLA program runs all num_sgd_iter × minibatch updates on the mesh.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import sample_batch as sb
+from ...policy.jax_policy_template import build_jax_policy
+from ...optimizers.sync_samples_optimizer import MultiDeviceOptimizer
+from ..trainer import with_common_config
+from ..trainer_template import build_trainer
+
+DEFAULT_CONFIG = with_common_config({
+    "lr": 5e-5,
+    "gamma": 0.99,
+    "use_gae": True,
+    "lambda": 1.0,
+    "kl_coeff": 0.2,
+    "kl_target": 0.01,
+    "rollout_fragment_length": 200,
+    "train_batch_size": 4000,
+    "sgd_minibatch_size": 128,
+    "num_sgd_iter": 30,
+    "clip_param": 0.3,
+    "vf_clip_param": 10.0,
+    "vf_loss_coeff": 1.0,
+    "entropy_coeff": 0.0,
+    "grad_clip": None,
+    "loss_state": {"kl_coeff": 0.2},
+})
+
+
+def ppo_loss(policy, params, batch, rng, loss_state):
+    cfg = policy.config
+    dist_inputs, value = policy.apply(params, batch[sb.OBS])
+    dist = policy.dist_class(dist_inputs)
+    old_dist = policy.dist_class(batch[sb.ACTION_DIST_INPUTS])
+
+    logp = dist.logp(batch[sb.ACTIONS])
+    ratio = jnp.exp(logp - batch[sb.ACTION_LOGP])
+    adv = batch[sb.ADVANTAGES]
+    clip_param = cfg["clip_param"]
+    surrogate = jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1.0 - clip_param, 1.0 + clip_param) * adv)
+
+    kl = old_dist.kl(dist)
+    entropy = dist.entropy()
+
+    # Clipped value loss (reference ppo_policy: vf_clip_param).
+    v_target = batch[sb.VALUE_TARGETS]
+    v_old = batch[sb.VF_PREDS]
+    vf_err1 = (value - v_target) ** 2
+    v_clipped = v_old + jnp.clip(value - v_old, -cfg["vf_clip_param"],
+                                 cfg["vf_clip_param"])
+    vf_err2 = (v_clipped - v_target) ** 2
+    vf_loss = jnp.maximum(vf_err1, vf_err2)
+
+    kl_coeff = loss_state.get("kl_coeff", jnp.float32(0.0))
+    total = jnp.mean(
+        -surrogate
+        + kl_coeff * kl
+        + cfg["vf_loss_coeff"] * vf_loss
+        - cfg["entropy_coeff"] * entropy)
+    stats = {
+        "total_loss": total,
+        "policy_loss": -jnp.mean(surrogate),
+        "vf_loss": jnp.mean(vf_loss),
+        "kl": jnp.mean(kl),
+        "entropy": jnp.mean(entropy),
+        "vf_explained_var": explained_variance(v_target, value),
+    }
+    return total, stats
+
+
+def explained_variance(y, pred):
+    y_var = jnp.var(y)
+    diff_var = jnp.var(y - pred)
+    return jnp.maximum(-1.0, 1.0 - diff_var / (y_var + 1e-8))
+
+
+PPOJaxPolicy = build_jax_policy(
+    "PPOJaxPolicy", ppo_loss, get_default_config=lambda: DEFAULT_CONFIG)
+
+
+def make_ppo_optimizer(workers, config):
+    return MultiDeviceOptimizer(
+        workers,
+        train_batch_size=config["train_batch_size"],
+        num_sgd_iter=config["num_sgd_iter"],
+        sgd_minibatch_size=config["sgd_minibatch_size"])
+
+
+def update_kl(trainer, fetches):
+    """Adaptive KL coefficient (reference: `ppo.py` update_kl /
+    `ppo_policy.py` KLCoeffMixin)."""
+    policy = trainer.get_policy()
+    if "kl" not in fetches or not policy.loss_state:
+        return
+    kl, target = fetches["kl"], trainer.config["kl_target"]
+    coeff = float(policy.loss_state["kl_coeff"])
+    if kl > 2.0 * target:
+        coeff *= 1.5
+    elif kl < 0.5 * target:
+        coeff *= 0.5
+    policy.update_loss_state(kl_coeff=coeff)
+
+
+def validate_config(config):
+    if config["sgd_minibatch_size"] > config["train_batch_size"]:
+        raise ValueError("sgd_minibatch_size must be <= train_batch_size")
+    if config["entropy_coeff"] < 0:
+        raise ValueError("entropy_coeff must be >= 0")
+
+
+PPOTrainer = build_trainer(
+    name="PPO",
+    default_policy=PPOJaxPolicy,
+    default_config=DEFAULT_CONFIG,
+    make_policy_optimizer=make_ppo_optimizer,
+    validate_config=validate_config,
+    after_optimizer_step=update_kl)

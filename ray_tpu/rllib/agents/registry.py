@@ -1,0 +1,24 @@
+"""Algorithm registry (parity: `rllib/agents/registry.py:98`)."""
+
+
+def _pg():
+    from .pg import PGTrainer
+    return PGTrainer
+
+
+def _ppo():
+    from .ppo import PPOTrainer
+    return PPOTrainer
+
+
+ALGORITHMS = {
+    "PG": _pg,
+    "PPO": _ppo,
+}
+
+
+def get_trainer_class(name: str):
+    if name not in ALGORITHMS:
+        raise ValueError(
+            f"unknown algorithm {name!r}; available: {sorted(ALGORITHMS)}")
+    return ALGORITHMS[name]()

@@ -1,0 +1,228 @@
+"""Trainer: the user-facing algorithm runner.
+
+Parity: `rllib/agents/trainer.py:335` — extends the Tune `Trainable`,
+builds a WorkerSet in `_setup` (:494), runs the policy optimizer per
+`train()` with worker-failure handling (:425), checkpoints policy +
+optimizer state via get/set state (:857), and exposes
+`compute_action`/`get_policy`/`workers`.
+
+COMMON_CONFIG mirrors the reference's vocabulary (:39): num_workers,
+num_envs_per_worker, rollout_fragment_length (the reference's
+sample_batch_size), train_batch_size, gamma, lr, model, ... plus
+TPU-specific: num_tpus_for_learner (mesh size for the learner program).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import pickle
+from typing import Callable, Optional, Type
+
+import ray_tpu
+from ray_tpu.exceptions import RayError
+
+from ...tune.trainable import Trainable
+from ..env.registry import make_env
+from ..evaluation.metrics import collect_episodes, summarize_episodes
+from ..evaluation.worker_set import WorkerSet
+
+logger = logging.getLogger(__name__)
+
+COMMON_CONFIG = {
+    # === Rollouts ===
+    "num_workers": 0,
+    "num_envs_per_worker": 1,
+    "rollout_fragment_length": 200,
+    "batch_mode": "truncate_episodes",
+    "horizon": None,
+    "observation_filter": "NoFilter",
+    # === Training ===
+    "gamma": 0.99,
+    "lr": 5e-5,
+    "train_batch_size": 200,
+    "model": {},
+    "optimizer": {},
+    "grad_clip": None,
+    "seed": None,
+    # === Environment ===
+    "env": None,
+    "env_config": {},
+    # === Resources ===
+    "num_cpus_per_worker": 1,
+    # TPU devices the learner's mesh spans (0 = single default device).
+    "num_tpus_for_learner": 0,
+    # === Fault tolerance (parity: trainer.py:425) ===
+    "ignore_worker_failures": False,
+    # === Evaluation ===
+    "evaluation_interval": None,
+    "evaluation_num_episodes": 10,
+    # === Reporting ===
+    "min_iter_time_s": 0,
+    "timesteps_per_iteration": 0,
+}
+
+
+def with_common_config(extra: dict) -> dict:
+    cfg = deep_merge({}, COMMON_CONFIG)
+    return deep_merge(cfg, extra)
+
+
+def deep_merge(base: dict, new: dict) -> dict:
+    for k, v in (new or {}).items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            deep_merge(base[k], v)
+        else:
+            base[k] = v
+    return base
+
+
+class Trainer(Trainable):
+    _name = "Trainer"
+    _default_config = COMMON_CONFIG
+    _policy_cls = None
+
+    def __init__(self, config: Optional[dict] = None,
+                 env: Optional[str] = None, logger_creator=None):
+        config = config or {}
+        if env is not None:
+            config["env"] = env
+        super().__init__(config, logger_creator)
+
+    # ------------------------------------------------------------------
+    def _setup(self, config: dict):
+        merged = deep_merge(deep_merge({}, self._default_config), config)
+        self.config = merged
+        env_name = merged.get("env")
+        if callable(env_name):
+            self.env_creator = env_name
+        elif env_name is not None:
+            self.env_creator = lambda cfg, _n=env_name: make_env(_n, cfg)
+        else:
+            raise ValueError("config['env'] is required")
+        self._make_mesh()
+        self._init(merged, self.env_creator)
+
+    def _make_mesh(self):
+        """Build the learner mesh (TPU devices if present)."""
+        from ...parallel import mesh as mesh_lib
+        n = self.config.get("num_tpus_for_learner") or 0
+        try:
+            if n:
+                self.learner_mesh = mesh_lib.make_mesh(num_devices=n)
+            else:
+                self.learner_mesh = mesh_lib.make_mesh(num_devices=1)
+        except Exception:
+            self.learner_mesh = None
+
+    def _init(self, config, env_creator):
+        """Subclasses/templates build workers + optimizer here."""
+        raise NotImplementedError
+
+    def _make_workers(self, policy_cls) -> WorkerSet:
+        return WorkerSet(
+            self.env_creator, policy_cls, self.config,
+            num_workers=self.config["num_workers"],
+            local_mesh=self.learner_mesh)
+
+    # ------------------------------------------------------------------
+    def _train(self) -> dict:
+        """One training iteration with worker-failure retry (parity:
+        `Trainer.train`, trainer.py:425)."""
+        for attempt in range(3):
+            try:
+                return self._train_inner()
+            except RayError as e:
+                if not self.config.get("ignore_worker_failures"):
+                    raise
+                logger.warning("worker failure: %s; recreating workers", e)
+                self._recover_workers()
+        raise RuntimeError("training failed after worker recovery attempts")
+
+    def _train_inner(self) -> dict:
+        raise NotImplementedError
+
+    def _recover_workers(self):
+        healthy = []
+        for w in list(self.workers.remote_workers):
+            try:
+                ray_tpu.get(w.ping.remote(), timeout=10)
+                healthy.append(w)
+            except Exception:
+                try:
+                    self.workers.recreate_failed_worker(w)
+                except Exception:
+                    logger.exception("failed to recreate worker")
+        return healthy
+
+    def _result_from_optimizer(self, optimizer, extra: dict = None) -> dict:
+        episodes = collect_episodes(self.workers)
+        self._episode_history = getattr(self, "_episode_history", [])
+        result = summarize_episodes(
+            episodes, smoothed=self._episode_history)
+        self._episode_history = (self._episode_history + episodes)[-100:]
+        result.update(optimizer.stats())
+        result["timesteps_this_iter"] = (
+            optimizer.num_steps_sampled
+            - getattr(self, "_last_steps_sampled", 0))
+        self._last_steps_sampled = optimizer.num_steps_sampled
+        result["info"] = {"learner": getattr(optimizer, "learner_stats", {})}
+        if extra:
+            result.update(extra)
+        return result
+
+    # ------------------------------------------------------------------
+    def get_policy(self):
+        return self.workers.local_worker.policy
+
+    def compute_action(self, obs, state=None, explore=False):
+        action, _, _ = self.get_policy().compute_single_action(
+            obs, state, explore=explore)
+        return action
+
+    # ------------------------------------------------------------------
+    def __getstate__(self) -> dict:
+        """Checkpointable state (parity: `trainer.py:857`)."""
+        state = {"policy": self.get_policy().get_state(),
+                 "config_overrides": {}}
+        if hasattr(self.workers.local_worker, "obs_filter"):
+            state["obs_filter"] = \
+                self.workers.local_worker.get_filters()
+        opt = getattr(self, "optimizer", None)
+        if opt is not None:
+            state["optimizer"] = opt.save()
+        return state
+
+    def __setstate__(self, state: dict):
+        self.get_policy().set_state(state["policy"])
+        if "obs_filter" in state:
+            self.workers.local_worker.sync_filters(state["obs_filter"])
+        opt = getattr(self, "optimizer", None)
+        if opt is not None and "optimizer" in state:
+            opt.restore(state["optimizer"])
+        self.workers.sync_weights()
+
+    def _save(self, checkpoint_dir: str) -> str:
+        path = os.path.join(checkpoint_dir, "checkpoint.pkl")
+        with open(path, "wb") as f:
+            pickle.dump(self.__getstate__(), f)
+        return path
+
+    def _restore(self, checkpoint_path: str):
+        with open(checkpoint_path, "rb") as f:
+            self.__setstate__(pickle.load(f))
+
+    def _stop(self):
+        if hasattr(self, "workers"):
+            self.workers.stop()
+        opt = getattr(self, "optimizer", None)
+        if opt is not None:
+            opt.stop()
+
+    @classmethod
+    def default_resource_request(cls, config: dict):
+        cfg = deep_merge(deep_merge({}, cls._default_config), config or {})
+        return {
+            "CPU": 1 + cfg["num_workers"] * cfg.get("num_cpus_per_worker", 1),
+            "TPU": cfg.get("num_tpus_for_learner", 0),
+        }

@@ -1,0 +1,64 @@
+"""Declarative trainer factory.
+
+Parity: `rllib/agents/trainer_template.py:9` `build_trainer` — every
+built-in algorithm is a policy class + an optimizer choice + hooks.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..optimizers.sync_samples_optimizer import SyncSamplesOptimizer
+from .trainer import Trainer, deep_merge
+
+
+def build_trainer(name: str,
+                  default_policy,
+                  default_config: Optional[dict] = None,
+                  make_policy_optimizer: Optional[Callable] = None,
+                  validate_config: Optional[Callable] = None,
+                  before_init: Optional[Callable] = None,
+                  after_init: Optional[Callable] = None,
+                  before_train_step: Optional[Callable] = None,
+                  after_optimizer_step: Optional[Callable] = None,
+                  after_train_result: Optional[Callable] = None,
+                  get_policy_class: Optional[Callable] = None):
+    """Returns a Trainer subclass named `name`."""
+
+    class _Trainer(Trainer):
+        _name = name
+        _default_config = default_config or Trainer._default_config
+        _policy_cls = default_policy
+
+        def _init(self, config, env_creator):
+            if validate_config:
+                validate_config(config)
+            policy_cls = default_policy
+            if get_policy_class:
+                policy_cls = get_policy_class(config)
+            if before_init:
+                before_init(self)
+            self.workers = self._make_workers(policy_cls)
+            if make_policy_optimizer:
+                self.optimizer = make_policy_optimizer(self.workers, config)
+            else:
+                self.optimizer = SyncSamplesOptimizer(
+                    self.workers,
+                    train_batch_size=config["train_batch_size"])
+            if after_init:
+                after_init(self)
+
+        def _train_inner(self):
+            if before_train_step:
+                before_train_step(self)
+            fetches = self.optimizer.step()
+            if after_optimizer_step:
+                after_optimizer_step(self, fetches)
+            result = self._result_from_optimizer(self.optimizer)
+            if after_train_result:
+                after_train_result(self, result)
+            return result
+
+    _Trainer.__name__ = name
+    _Trainer.__qualname__ = name
+    return _Trainer

@@ -1,0 +1,4 @@
+from .env import CartPole, Env, Pendulum, StatelessCartPole, SyntheticAtari  # noqa: F401
+from .registry import make_env, register_env, registered_envs  # noqa: F401
+from .spaces import Box, DictSpace, Discrete, MultiDiscrete, Space, TupleSpace  # noqa: F401
+from .vector_env import VectorEnv  # noqa: F401

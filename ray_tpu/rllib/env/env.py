@@ -1,0 +1,185 @@
+"""Single-agent environment API + built-in environments.
+
+The reference uses OpenAI gym environments (CartPole-v0, Pendulum-v0, Atari)
+throughout its tuned examples and tests. gym is not available here, so the
+classic-control environments are implemented natively with the same
+dynamics, observation/action spaces, and episode-termination rules, plus a
+synthetic Atari-shaped environment for throughput benchmarking.
+
+API: `reset() -> obs`, `step(action) -> (obs, reward, done, info)` —
+the same contract RLlib's samplers expect.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .spaces import Box, Discrete
+
+
+class Env:
+    observation_space = None
+    action_space = None
+
+    def reset(self):
+        raise NotImplementedError
+
+    def step(self, action):
+        raise NotImplementedError
+
+    def seed(self, seed=None):
+        self._rng = np.random.default_rng(seed)
+
+    def close(self):
+        pass
+
+
+class CartPole(Env):
+    """Cart-pole balancing (dynamics per Barto-Sutton-Anderson '83, matching
+    gym CartPole-v0: 200-step limit, +1 reward per step, terminate at
+    |x|>2.4 or |theta|>12deg)."""
+
+    def __init__(self, max_steps: int = 200):
+        self.gravity = 9.8
+        self.masscart, self.masspole = 1.0, 0.1
+        self.total_mass = self.masscart + self.masspole
+        self.length = 0.5  # half pole length
+        self.polemass_length = self.masspole * self.length
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        self.max_steps = max_steps
+        high = np.array([self.x_threshold * 2, np.finfo(np.float32).max,
+                         self.theta_threshold * 2, np.finfo(np.float32).max],
+                        dtype=np.float32)
+        self.observation_space = Box(-high, high)
+        self.action_space = Discrete(2)
+        self._rng = np.random.default_rng()
+        self._state = None
+        self._t = 0
+
+    def reset(self):
+        self._state = self._rng.uniform(-0.05, 0.05, size=4)
+        self._t = 0
+        return self._state.astype(np.float32)
+
+    def step(self, action):
+        x, x_dot, theta, theta_dot = self._state
+        force = self.force_mag if action == 1 else -self.force_mag
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        temp = (force + self.polemass_length * theta_dot ** 2 * sintheta) \
+            / self.total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0
+                           - self.masspole * costheta ** 2 / self.total_mass))
+        xacc = temp - self.polemass_length * thetaacc * costheta / self.total_mass
+        x += self.tau * x_dot
+        x_dot += self.tau * xacc
+        theta += self.tau * theta_dot
+        theta_dot += self.tau * thetaacc
+        self._state = np.array([x, x_dot, theta, theta_dot])
+        self._t += 1
+        done = bool(abs(x) > self.x_threshold
+                    or abs(theta) > self.theta_threshold
+                    or self._t >= self.max_steps)
+        return self._state.astype(np.float32), 1.0, done, {}
+
+
+class Pendulum(Env):
+    """Torque-controlled pendulum swing-up (matching gym Pendulum-v0:
+    200-step episodes, continuous action in [-2, 2])."""
+
+    def __init__(self, max_steps: int = 200):
+        self.max_speed = 8.0
+        self.max_torque = 2.0
+        self.dt = 0.05
+        self.g, self.m, self.l = 10.0, 1.0, 1.0
+        self.max_steps = max_steps
+        high = np.array([1.0, 1.0, self.max_speed], dtype=np.float32)
+        self.observation_space = Box(-high, high)
+        self.action_space = Box(-self.max_torque, self.max_torque, shape=(1,))
+        self._rng = np.random.default_rng()
+
+    def reset(self):
+        self._theta = self._rng.uniform(-np.pi, np.pi)
+        self._thetadot = self._rng.uniform(-1.0, 1.0)
+        self._t = 0
+        return self._obs()
+
+    def _obs(self):
+        return np.array([np.cos(self._theta), np.sin(self._theta),
+                         self._thetadot], dtype=np.float32)
+
+    def step(self, action):
+        u = float(np.clip(np.asarray(action).reshape(-1)[0],
+                          -self.max_torque, self.max_torque))
+        th, thdot = self._theta, self._thetadot
+        norm_th = ((th + np.pi) % (2 * np.pi)) - np.pi
+        cost = norm_th ** 2 + 0.1 * thdot ** 2 + 0.001 * u ** 2
+        thdot = thdot + (3 * self.g / (2 * self.l) * np.sin(th)
+                         + 3.0 / (self.m * self.l ** 2) * u) * self.dt
+        thdot = np.clip(thdot, -self.max_speed, self.max_speed)
+        th = th + thdot * self.dt
+        self._theta, self._thetadot = th, thdot
+        self._t += 1
+        return self._obs(), -float(cost), self._t >= self.max_steps, {}
+
+
+class SyntheticAtari(Env):
+    """Atari-shaped throughput environment: 84x84x4 uint8 frames, 6 actions.
+
+    Stands in for ALE (not available in this image) when measuring
+    sampler/learner throughput at the reference's Atari configuration
+    (reference preprocessing: `rllib/env/atari_wrappers.py` produces
+    84x84x4 stacked frames). Observations carry a learnable signal (frame
+    intensity encodes the best action) so policies must do real work.
+    """
+
+    def __init__(self, episode_len: int = 1000, num_actions: int = 6):
+        self.observation_space = Box(0, 255, shape=(84, 84, 4), dtype=np.uint8)
+        self.action_space = Discrete(num_actions)
+        self.episode_len = episode_len
+        self.num_actions = num_actions
+        self._rng = np.random.default_rng()
+
+    def reset(self):
+        self._t = 0
+        self._target = int(self._rng.integers(self.num_actions))
+        return self._frame()
+
+    def _frame(self):
+        frame = self._rng.integers(
+            0, 64, size=(84, 84, 4), dtype=np.uint8)
+        # Embed the target action as a bright band.
+        band = 84 // self.num_actions
+        frame[self._target * band:(self._target + 1) * band, :, :] += 128
+        return frame
+
+    def step(self, action):
+        reward = 1.0 if int(action) == self._target else 0.0
+        self._t += 1
+        self._target = int(self._rng.integers(self.num_actions))
+        return self._frame(), reward, self._t >= self.episode_len, {}
+
+
+class StatelessCartPole(CartPole):
+    """CartPole with velocity components hidden — requires memory (used to
+    exercise recurrent policies, parity: RLlib's stateless cartpole
+    example)."""
+
+    def __init__(self, max_steps: int = 200):
+        super().__init__(max_steps)
+        high = np.array([self.x_threshold * 2, self.theta_threshold * 2],
+                        dtype=np.float32)
+        self.observation_space = Box(-high, high)
+
+    def _mask(self, obs):
+        return obs[[0, 2]]
+
+    def reset(self):
+        return self._mask(super().reset())
+
+    def step(self, action):
+        obs, r, d, i = super().step(action)
+        return self._mask(obs), r, d, i

@@ -1,0 +1,42 @@
+"""Environment registry.
+
+Parity: the reference resolves env names via gym + `tune.registry`'s
+`register_env` (`rllib/agents/trainer.py` `_setup`). Built-in names mirror
+the gym ids used by the reference's tuned examples.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+from .env import CartPole, Pendulum, StatelessCartPole, SyntheticAtari
+
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_env(name: str, creator: Callable) -> None:
+    """Register `creator(env_config) -> Env` under `name`."""
+    _REGISTRY[name] = creator
+
+
+def make_env(name: str, env_config: dict = None):
+    env_config = env_config or {}
+    if name in _REGISTRY:
+        return _REGISTRY[name](env_config)
+    raise ValueError(
+        f"unknown env {name!r}; registered: {sorted(_REGISTRY)}")
+
+
+def registered_envs():
+    return sorted(_REGISTRY)
+
+
+# Built-ins (same ids the reference's yamls use).
+register_env("CartPole-v0", lambda cfg: CartPole(max_steps=200))
+register_env("CartPole-v1", lambda cfg: CartPole(max_steps=500))
+register_env("Pendulum-v0", lambda cfg: Pendulum())
+register_env("StatelessCartPole-v0", lambda cfg: StatelessCartPole())
+register_env("SyntheticAtari-v0",
+             lambda cfg: SyntheticAtari(
+                 episode_len=cfg.get("episode_len", 1000),
+                 num_actions=cfg.get("num_actions", 6)))

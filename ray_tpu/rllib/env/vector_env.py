@@ -1,0 +1,45 @@
+"""Vectorized environment wrapper.
+
+Parity: `rllib/env/vector_env.py` — N copies of an env stepped as a batch,
+with auto-reset on episode end. This is the sampler's unit of work: the
+policy sees (num_envs, *obs_shape) batches, which is what keeps the
+device-side `compute_actions` efficient.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List
+
+import numpy as np
+
+
+class VectorEnv:
+    def __init__(self, make_env: Callable[[], object], num_envs: int):
+        self.envs = [make_env() for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.observation_space = self.envs[0].observation_space
+        self.action_space = self.envs[0].action_space
+
+    def seed(self, seed: int):
+        for i, e in enumerate(self.envs):
+            e.seed(seed + i)
+
+    def reset(self) -> np.ndarray:
+        return np.stack([e.reset() for e in self.envs])
+
+    def reset_at(self, i: int):
+        return self.envs[i].reset()
+
+    def step(self, actions):
+        """Steps all envs; returns (obs, rewards, dones, infos). Done envs
+        are NOT auto-reset — the caller decides (the sampler resets and
+        records episode boundaries)."""
+        obs_list, rewards, dones, infos = [], [], [], []
+        for e, a in zip(self.envs, actions):
+            o, r, d, i = e.step(a)
+            obs_list.append(o)
+            rewards.append(r)
+            dones.append(d)
+            infos.append(i)
+        return (np.stack(obs_list), np.asarray(rewards, dtype=np.float32),
+                np.asarray(dones), infos)

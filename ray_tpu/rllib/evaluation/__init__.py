@@ -1,0 +1,4 @@
+from .postprocessing import compute_advantages  # noqa: F401
+from .rollout_worker import RolloutWorker  # noqa: F401
+from .sampler import RolloutMetrics, SyncSampler  # noqa: F401
+from .worker_set import WorkerSet  # noqa: F401

@@ -1,0 +1,39 @@
+"""Episode metric aggregation.
+
+Parity: `rllib/evaluation/metrics.py:39` `collect_metrics` — gather
+RolloutMetrics from local + remote workers and summarize into the result
+dict `Trainer.train()` returns.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+import ray_tpu
+
+
+def collect_episodes(workers, timeout: float = 60) -> List:
+    episodes = list(workers.local_worker.get_metrics())
+    if workers.remote_workers:
+        refs = [w.get_metrics.remote() for w in workers.remote_workers]
+        ready, _ = ray_tpu.wait(refs, num_returns=len(refs), timeout=timeout)
+        for r in ready:
+            episodes.extend(ray_tpu.get(r))
+    return episodes
+
+
+def summarize_episodes(episodes, smoothed: List = None) -> dict:
+    pool = list(episodes)
+    if smoothed:
+        pool = (list(smoothed) + pool)[-100:]
+    rewards = [e.episode_reward for e in pool]
+    lengths = [e.episode_length for e in pool]
+    return {
+        "episode_reward_mean": float(np.mean(rewards)) if rewards else np.nan,
+        "episode_reward_min": float(np.min(rewards)) if rewards else np.nan,
+        "episode_reward_max": float(np.max(rewards)) if rewards else np.nan,
+        "episode_len_mean": float(np.mean(lengths)) if lengths else np.nan,
+        "episodes_this_iter": len(episodes),
+    }

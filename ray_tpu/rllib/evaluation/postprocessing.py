@@ -1,0 +1,48 @@
+"""Advantage estimation.
+
+Parity: `rllib/evaluation/postprocessing.py` `compute_advantages` — GAE
+(Schulman et al. 2016) or plain discounted returns. Host-side numpy: the
+sampler calls this per finished trajectory chunk (small arrays); the
+vectorized reverse scan below is O(T) with no Python-per-step overhead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import sample_batch as sb
+from ..sample_batch import SampleBatch
+
+
+def discount_cumsum(x: np.ndarray, gamma: float) -> np.ndarray:
+    """y[t] = sum_{k>=t} gamma^(k-t) x[k] via reverse scan."""
+    out = np.empty_like(x, dtype=np.float32)
+    acc = 0.0
+    for t in range(len(x) - 1, -1, -1):
+        acc = x[t] + gamma * acc
+        out[t] = acc
+    return out
+
+
+def compute_advantages(rollout: SampleBatch, last_r: float,
+                       gamma: float = 0.9, lambda_: float = 1.0,
+                       use_gae: bool = True,
+                       use_critic: bool = True) -> SampleBatch:
+    rewards = np.asarray(rollout[sb.REWARDS], dtype=np.float32)
+    if use_gae:
+        vpred = np.asarray(rollout[sb.VF_PREDS], dtype=np.float32)
+        vpred_t = np.concatenate([vpred, [last_r]])
+        delta = rewards + gamma * vpred_t[1:] - vpred_t[:-1]
+        adv = discount_cumsum(delta, gamma * lambda_)
+        rollout[sb.ADVANTAGES] = adv.astype(np.float32)
+        rollout[sb.VALUE_TARGETS] = (adv + vpred).astype(np.float32)
+    else:
+        returns = discount_cumsum(
+            np.concatenate([rewards, [last_r]]), gamma)[:-1]
+        if use_critic and sb.VF_PREDS in rollout:
+            rollout[sb.ADVANTAGES] = \
+                returns - np.asarray(rollout[sb.VF_PREDS], dtype=np.float32)
+        else:
+            rollout[sb.ADVANTAGES] = returns
+        rollout[sb.VALUE_TARGETS] = returns.astype(np.float32)
+    return rollout

@@ -1,0 +1,134 @@
+"""RolloutWorker: the sampling unit.
+
+Parity: `rllib/evaluation/rollout_worker.py:55` — builds env + policy +
+sampler; `sample` (:463), `learn_on_batch` (:595),
+`compute_gradients`/`apply_gradients` (:542/:574), `get/set_weights`
+(:528/:537). Created locally on the trainer and as remote actors for
+parallel sampling (`WorkerSet`). Remote rollout workers run JAX on CPU —
+TPU chips belong to the learner (Podracer/Sebulba split, SURVEY.md §7.1).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import sample_batch as sb
+from ..env.registry import make_env
+from ..env.vector_env import VectorEnv
+from ..sample_batch import SampleBatch
+from ..utils.filter import get_filter
+from .postprocessing import compute_advantages
+from .sampler import SyncSampler
+
+
+class RolloutWorker:
+    def __init__(self,
+                 env_creator: Callable,
+                 policy_cls,
+                 policy_config: dict,
+                 num_envs: int = 1,
+                 rollout_fragment_length: int = 100,
+                 worker_index: int = 0,
+                 seed: Optional[int] = None,
+                 observation_filter: str = "NoFilter",
+                 explore: bool = True,
+                 env_config: Optional[dict] = None):
+        self.worker_index = worker_index
+        env_config = dict(env_config or {})
+        env_config["worker_index"] = worker_index
+        self.env = VectorEnv(lambda: env_creator(env_config), num_envs)
+        if seed is not None:
+            self.env.seed(seed + worker_index * 1000)
+            np.random.seed(seed + worker_index * 1000)
+        cfg = dict(policy_config)
+        if seed is not None:
+            cfg["seed"] = seed + worker_index
+        self.policy = policy_cls(
+            self.env.observation_space, self.env.action_space, cfg)
+        self.obs_filter = get_filter(
+            observation_filter, self.env.observation_space.shape)
+
+        gamma = cfg.get("gamma", 0.99)
+        lambda_ = cfg.get("lambda", 1.0)
+        use_gae = cfg.get("use_gae", True)
+        use_critic = cfg.get("use_critic", True)
+
+        def postprocess(chunk: SampleBatch, bootstrap_obs):
+            if bootstrap_obs is None or not use_gae:
+                last_r = 0.0
+            else:
+                last_r = float(self.policy.value_function(
+                    bootstrap_obs[None])[0])
+            if sb.VF_PREDS in chunk or use_gae:
+                chunk = compute_advantages(
+                    chunk, last_r, gamma=gamma, lambda_=lambda_,
+                    use_gae=use_gae and sb.VF_PREDS in chunk,
+                    use_critic=use_critic)
+            return self.policy.postprocess_trajectory(chunk)
+
+        self.sampler = SyncSampler(
+            self.env, self.policy, rollout_fragment_length,
+            postprocess_fn=postprocess,
+            obs_filter=self.obs_filter if observation_filter != "NoFilter"
+            else None,
+            explore=explore)
+
+    # -- sampling --------------------------------------------------------
+    def sample(self) -> SampleBatch:
+        return self.sampler.sample()
+
+    def sample_with_count(self):
+        batch = self.sample()
+        return batch, batch.count
+
+    # -- learning (used when the worker doubles as a learner) ------------
+    def learn_on_batch(self, batch) -> Dict:
+        return self.policy.learn_on_batch(batch)
+
+    def compute_gradients(self, batch):
+        return self.policy.compute_gradients(batch)
+
+    def apply_gradients(self, grads):
+        return self.policy.apply_gradients(grads)
+
+    # -- weights ---------------------------------------------------------
+    def get_weights(self):
+        return self.policy.get_weights()
+
+    def set_weights(self, weights):
+        self.policy.set_weights(weights)
+
+    # -- filters (parity: FilterManager.synchronize) ---------------------
+    def get_filters(self, flush_after: bool = False):
+        f = self.obs_filter.as_serializable()
+        if flush_after:
+            self.obs_filter.clear_buffer()
+        return f
+
+    def sync_filters(self, new_filter):
+        self.obs_filter.sync(new_filter)
+
+    # -- metrics / introspection -----------------------------------------
+    def get_metrics(self) -> List:
+        return self.sampler.get_metrics()
+
+    def get_policy_state(self):
+        return self.policy.get_state()
+
+    def set_policy_state(self, state):
+        self.policy.set_state(state)
+
+    def ping(self):
+        return "ok"
+
+    def stop(self):
+        self.env.envs and [e.close() for e in self.env.envs]
+
+
+def make_remote_worker_env() -> dict:
+    """Env vars for remote rollout-worker actors: JAX on CPU so the single
+    TPU stays with the learner process."""
+    return {"JAX_PLATFORMS": "cpu", "PALLAS_AXON_POOL_IPS": "",
+            "XLA_FLAGS": "--xla_force_host_platform_device_count=1"}

@@ -1,0 +1,150 @@
+"""The env-stepping hot loop.
+
+Parity: `rllib/evaluation/sampler.py:60,226` (`SyncSampler` around
+`_env_runner`) — poll the vectorized env, batch observations, one
+`compute_actions` per step (a single jitted device call covering all envs),
+build per-env trajectories, postprocess on episode end or fragment
+truncation with value bootstrapping.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from .. import sample_batch as sb
+from ..sample_batch import SampleBatch
+
+RolloutMetrics = collections.namedtuple(
+    "RolloutMetrics", ["episode_length", "episode_reward"])
+
+
+class _EpisodeBuilder:
+    """Accumulates one env slot's current episode fragment."""
+
+    __slots__ = ("columns", "eps_id", "ep_reward", "ep_len")
+
+    def __init__(self, eps_id: int):
+        self.columns = collections.defaultdict(list)
+        self.eps_id = eps_id
+        self.ep_reward = 0.0
+        self.ep_len = 0
+
+    def add(self, **row):
+        for k, v in row.items():
+            self.columns[k].append(v)
+
+    def count(self):
+        return len(self.columns[sb.OBS])
+
+    def build(self) -> SampleBatch:
+        out = {}
+        for k, v in self.columns.items():
+            if k == sb.INFOS:
+                out[k] = list(v)
+            else:
+                out[k] = np.stack(v) if isinstance(v[0], np.ndarray) \
+                    else np.asarray(v)
+        n = len(out[sb.OBS])
+        out[sb.EPS_ID] = np.full(n, self.eps_id, dtype=np.int64)
+        return SampleBatch(out)
+
+
+class SyncSampler:
+    """Steps a VectorEnv for `rollout_fragment_length` steps per sample().
+
+    `postprocess_fn(batch, last_obs or None) -> batch` is applied per
+    trajectory chunk: at episode end with last_obs=None (terminal), or at
+    fragment truncation with the bootstrap observation.
+    """
+
+    def __init__(self, vector_env, policy,
+                 rollout_fragment_length: int,
+                 postprocess_fn: Optional[Callable] = None,
+                 obs_filter: Optional[Callable] = None,
+                 explore: bool = True,
+                 include_infos: bool = False,
+                 horizon: Optional[int] = None):
+        self.env = vector_env
+        self.policy = policy
+        self.T = rollout_fragment_length
+        self.postprocess_fn = postprocess_fn
+        self.obs_filter = obs_filter
+        self.explore = explore
+        self.include_infos = include_infos
+        self.horizon = horizon
+        self._eps_counter = 0
+        self._obs = self._filter(self.env.reset())
+        self._builders = [self._new_builder()
+                          for _ in range(self.env.num_envs)]
+        self.metrics: List[RolloutMetrics] = []
+
+    def _filter(self, obs):
+        if self.obs_filter is not None:
+            return np.stack([self.obs_filter(o) for o in obs])
+        return obs
+
+    def _new_builder(self):
+        self._eps_counter += 1
+        return _EpisodeBuilder(self._eps_counter)
+
+    def sample(self) -> SampleBatch:
+        chunks: List[SampleBatch] = []
+        for _ in range(self.T):
+            obs = self._obs
+            actions, _, extra = self.policy.compute_actions(
+                obs, explore=self.explore)
+            next_obs, rewards, dones, infos = self.env.step(actions)
+            next_obs = self._filter(next_obs)
+            for i in range(self.env.num_envs):
+                b = self._builders[i]
+                row = {
+                    sb.OBS: obs[i],
+                    sb.ACTIONS: actions[i],
+                    sb.REWARDS: np.float32(rewards[i]),
+                    sb.DONES: bool(dones[i]),
+                    sb.NEW_OBS: next_obs[i],
+                    sb.AGENT_INDEX: i,
+                    sb.T: b.ep_len,
+                }
+                for k, v in extra.items():
+                    row[k] = v[i]
+                if self.include_infos:
+                    row[sb.INFOS] = infos[i]
+                b.add(**row)
+                b.ep_reward += float(rewards[i])
+                b.ep_len += 1
+                if dones[i] or (self.horizon and b.ep_len >= self.horizon):
+                    self.metrics.append(
+                        RolloutMetrics(b.ep_len, b.ep_reward))
+                    chunk = b.build()
+                    if self.postprocess_fn is not None:
+                        chunk = self.postprocess_fn(chunk, None)
+                    chunks.append(chunk)
+                    self._builders[i] = self._new_builder()
+                    next_obs[i] = self.env.reset_at(i) \
+                        if self.obs_filter is None \
+                        else self.obs_filter(self.env.reset_at(i))
+            self._obs = next_obs
+        # Fragment boundary: flush partial trajectories with bootstrap obs.
+        for i in range(self.env.num_envs):
+            b = self._builders[i]
+            if b.count() > 0:
+                chunk = b.build()
+                if self.postprocess_fn is not None:
+                    chunk = self.postprocess_fn(chunk, self._obs[i])
+                chunks.append(chunk)
+                # Continue the same episode in a fresh builder (same eps id
+                # continuity is not required by GAE: each chunk was already
+                # postprocessed with its bootstrap value).
+                nb = _EpisodeBuilder(b.eps_id)
+                nb.ep_reward, nb.ep_len = b.ep_reward, b.ep_len
+                self._builders[i] = nb
+        return SampleBatch.concat_samples(chunks)
+
+    def get_metrics(self) -> List[RolloutMetrics]:
+        out = self.metrics
+        self.metrics = []
+        return out

@@ -1,0 +1,91 @@
+"""WorkerSet: one local RolloutWorker + N remote RolloutWorker actors.
+
+Parity: `rllib/evaluation/worker_set.py`. The local worker holds the
+learner-side policy (TPU); remote workers are actors pinned to CPU JAX via
+per-actor env vars (Podracer-style actor/learner split).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import ray_tpu
+
+from .rollout_worker import RolloutWorker, make_remote_worker_env
+
+
+class WorkerSet:
+    def __init__(self,
+                 env_creator: Callable,
+                 policy_cls,
+                 config: dict,
+                 num_workers: int = 0,
+                 local_mesh=None):
+        self._env_creator = env_creator
+        self._policy_cls = policy_cls
+        self._config = config
+        policy_config = dict(config.get("policy_config") or config)
+        local_policy_config = dict(policy_config)
+        if local_mesh is not None:
+            local_policy_config["_mesh"] = local_mesh
+
+        self.local_worker = RolloutWorker(
+            env_creator, policy_cls, local_policy_config,
+            num_envs=config.get("num_envs_per_worker", 1),
+            rollout_fragment_length=config.get("rollout_fragment_length", 100),
+            worker_index=0,
+            seed=config.get("seed"),
+            observation_filter=config.get("observation_filter", "NoFilter"),
+            env_config=config.get("env_config"))
+        self.remote_workers: List = []
+        if num_workers > 0:
+            self._remote_cls = ray_tpu.remote(RolloutWorker)
+            for i in range(num_workers):
+                self.remote_workers.append(self._make_remote_worker(i + 1))
+            # Block until all workers are constructed.
+            ray_tpu.get([w.ping.remote() for w in self.remote_workers])
+
+    def _make_remote_worker(self, index: int):
+        cfg = self._config
+        # Rollout policies never touch the TPU: the chip stays with the
+        # learner process (SURVEY.md §5.8 TPU-native equivalent).
+        policy_config = dict(cfg.get("policy_config") or cfg)
+        policy_config.pop("_mesh", None)
+        return self._remote_cls.options(
+            num_cpus=cfg.get("num_cpus_per_worker", 1),
+            env_vars=make_remote_worker_env()).remote(
+                self._env_creator, self._policy_cls, policy_config,
+                num_envs=cfg.get("num_envs_per_worker", 1),
+                rollout_fragment_length=cfg.get(
+                    "rollout_fragment_length", 100),
+                worker_index=index,
+                seed=cfg.get("seed"),
+                observation_filter=cfg.get("observation_filter", "NoFilter"),
+                env_config=cfg.get("env_config"))
+
+    # ------------------------------------------------------------------
+    def sync_weights(self):
+        """Broadcast local policy weights to all remote workers
+        (reference: ray.put broadcast in the optimizers)."""
+        if not self.remote_workers:
+            return
+        weights = ray_tpu.put(self.local_worker.get_weights())
+        ray_tpu.get([w.set_weights.remote(weights)
+                     for w in self.remote_workers])
+
+    def recreate_failed_worker(self, worker):
+        """Replace a dead remote worker (reference: `ignore_worker_failures`
+        path in `trainer.py:425`)."""
+        idx = self.remote_workers.index(worker)
+        new = self._make_remote_worker(idx + 1)
+        ray_tpu.get(new.ping.remote())
+        self.remote_workers[idx] = new
+        return new
+
+    def stop(self):
+        for w in self.remote_workers:
+            try:
+                ray_tpu.kill(w)
+            except Exception:
+                pass
+        self.local_worker.stop()

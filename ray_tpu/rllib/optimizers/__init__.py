@@ -1,0 +1,2 @@
+from .policy_optimizer import PolicyOptimizer  # noqa: F401
+from .sync_samples_optimizer import MultiDeviceOptimizer, SyncSamplesOptimizer  # noqa: F401

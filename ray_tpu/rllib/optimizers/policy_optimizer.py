@@ -1,0 +1,33 @@
+"""Policy optimizer base: a distributed-training strategy over a WorkerSet.
+
+Parity: `rllib/optimizers/policy_optimizer.py` — `step()` runs one round of
+sample collection + learning; counters feed the trainer's result dict.
+"""
+
+from __future__ import annotations
+
+
+class PolicyOptimizer:
+    def __init__(self, workers):
+        self.workers = workers
+        self.num_steps_trained = 0
+        self.num_steps_sampled = 0
+
+    def step(self) -> dict:
+        """One optimization round; returns learner stats."""
+        raise NotImplementedError
+
+    def stats(self) -> dict:
+        return {
+            "num_steps_trained": self.num_steps_trained,
+            "num_steps_sampled": self.num_steps_sampled,
+        }
+
+    def save(self):
+        return []
+
+    def restore(self, data):
+        pass
+
+    def stop(self):
+        pass

@@ -1,0 +1,3 @@
+from .jax_policy import JaxPolicy  # noqa: F401
+from .jax_policy_template import build_jax_policy  # noqa: F401
+from .policy import Policy, RandomPolicy  # noqa: F401

@@ -1,0 +1,326 @@
+"""JaxPolicy: the single policy stack (replaces the reference's dual
+TFPolicy/TorchPolicy towers).
+
+Parity: `rllib/policy/tf_policy.py` + `dynamic_tf_policy.py`, re-designed
+for XLA:
+
+- One flax model forward returns (dist_inputs, value); action sampling,
+  log-probs and value predictions compile into ONE jitted program used by
+  rollouts (`_action_fn`).
+- `learn_on_batch` is one donated-buffer jitted update (loss → grad →
+  optax), replacing feed-dict sess.run loss updates (`tf_policy.py:173`).
+- `sgd_learn` compiles the ENTIRE PPO-style minibatch-SGD phase
+  (num_sgd_iter epochs × minibatches, with on-device shuffling) into a
+  single XLA program — the TPU-native replacement for
+  `LocalSyncParallelOptimizer.optimize`'s per-minibatch feed_dict loop
+  (`rllib/optimizers/multi_gpu_impl.py:225`).
+- On a multi-device mesh, parameters are replicated and batches sharded on
+  the "dp" axis; XLA inserts gradient all-reduces over ICI (the replacement
+  for in-graph tower averaging, `multi_gpu_impl.py:310`).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from ...models import catalog
+from ...models.distributions import get_action_dist
+from ...parallel import mesh as mesh_lib
+from .. import sample_batch as sb
+from .policy import Policy
+
+# Columns that the device-side loss consumes; everything else stays host-side.
+_DEVICE_COLUMNS = (
+    sb.OBS, sb.NEW_OBS, sb.ACTIONS, sb.REWARDS, sb.DONES, sb.ACTION_LOGP,
+    sb.ACTION_DIST_INPUTS, sb.VF_PREDS, sb.ADVANTAGES, sb.VALUE_TARGETS,
+    sb.PREV_ACTIONS, sb.PREV_REWARDS, "weights", "seq_mask",
+)
+
+
+def default_optimizer(config: dict) -> optax.GradientTransformation:
+    clip = config.get("grad_clip")
+    lr = config.get("lr", 5e-5)
+    tx = optax.adam(lr, eps=config.get("adam_epsilon", 1e-7))
+    if clip:
+        tx = optax.chain(optax.clip_by_global_norm(clip), tx)
+    return tx
+
+
+class JaxPolicy(Policy):
+    """A policy defined by a flax model + a loss function.
+
+    loss_fn(policy, params, batch, rng, loss_state) -> (loss, stats);
+    it should call `policy.apply(params, batch[OBS])` for model outputs.
+    `loss_state` is a small dict of device scalars owned by the policy
+    (e.g. an adaptive KL coefficient) that can change between updates
+    without retracing. All computation inside loss_fn must be traceable.
+    """
+
+    def __init__(self, observation_space, action_space, config: dict,
+                 loss_fn: Callable,
+                 make_model: Optional[Callable] = None,
+                 optimizer_fn: Optional[Callable] = None,
+                 extra_action_out_fn: Optional[Callable] = None,
+                 postprocess_fn: Optional[Callable] = None,
+                 seed: Optional[int] = None):
+        super().__init__(observation_space, action_space, config)
+        self.dist_class, self.dist_dim = get_action_dist(action_space)
+        if make_model is not None:
+            self.model = make_model(observation_space, action_space, config)
+        else:
+            self.model = catalog.get_model(
+                observation_space, self.dist_dim, config.get("model"))
+        self._loss_fn = loss_fn
+        self._postprocess_fn = postprocess_fn
+        self._extra_action_out_fn = extra_action_out_fn
+
+        self.preprocessor = catalog.get_preprocessor(observation_space)
+        obs_shape = self.preprocessor.shape
+        obs_dtype = self.preprocessor.dtype
+
+        seed = seed if seed is not None else config.get("seed") or 0
+        self._host_rng = jax.random.PRNGKey(seed)
+        self._rng_counter = 0
+
+        dummy = np.zeros((1,) + tuple(obs_shape), dtype=obs_dtype)
+        self.params = self.model.init(self._next_rng(), dummy)
+        self.optimizer = (optimizer_fn or default_optimizer)(config)
+        self.opt_state = self.optimizer.init(self.params)
+
+        # Mesh: replicate params so the same program spans 1..N devices.
+        self.mesh = config.get("_mesh")
+        if self.mesh is None:
+            self.mesh = mesh_lib.make_mesh(num_devices=1)
+        self.params = mesh_lib.put_replicated(self.params, self.mesh)
+        self.opt_state = mesh_lib.put_replicated(self.opt_state, self.mesh)
+        self._repl = mesh_lib.replicated(self.mesh)
+        self._bsharded = mesh_lib.batch_sharded(self.mesh)
+
+        # Mutable device scalars consumed by the loss (adaptive KL etc.).
+        self.loss_state: Dict = {
+            k: jnp.asarray(v, jnp.float32)
+            for k, v in (config.get("loss_state") or {}).items()}
+
+        self._build_jitted_fns()
+        self._sgd_fns: Dict = {}
+        self.global_timestep = 0
+
+    # ------------------------------------------------------------------
+    def apply(self, params, obs, **kwargs):
+        """Model forward: (dist_inputs, value)."""
+        return self.model.apply(params, obs, **kwargs)
+
+    def _next_rng(self):
+        self._rng_counter += 1
+        return jax.random.fold_in(self._host_rng, self._rng_counter)
+
+    def _build_jitted_fns(self):
+        def action_fn(params, obs, rng, explore):
+            dist_inputs, value = self.apply(params, obs)
+            dist = self.dist_class(dist_inputs)
+            actions = jax.lax.cond(
+                explore,
+                lambda: dist.sample(rng),
+                lambda: dist.deterministic_sample())
+            logp = dist.logp(actions)
+            return actions, logp, dist_inputs, value
+
+        self._action_fn = jax.jit(action_fn)
+
+        def loss_and_grad(params, batch, rng, loss_state):
+            (loss, stats), grads = jax.value_and_grad(
+                self._loss_fn, argnums=1, has_aux=True)(
+                    self, params, batch, rng, loss_state)
+            return loss, stats, grads
+
+        def train_fn(params, opt_state, batch, rng, loss_state):
+            loss, stats, grads = loss_and_grad(params, batch, rng, loss_state)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            stats = dict(stats)
+            stats["grad_gnorm"] = optax.global_norm(grads)
+            return params, opt_state, stats
+
+        self._train_fn = jax.jit(
+            train_fn, donate_argnums=(0, 1),
+            in_shardings=(self._repl, self._repl, self._bsharded, self._repl,
+                          self._repl),
+            out_shardings=(self._repl, self._repl, self._repl))
+
+        def grad_fn(params, batch, rng, loss_state):
+            loss, stats, grads = loss_and_grad(params, batch, rng, loss_state)
+            stats = dict(stats)
+            return grads, stats
+
+        self._grad_fn = jax.jit(
+            grad_fn,
+            in_shardings=(self._repl, self._bsharded, self._repl, self._repl),
+            out_shardings=(self._repl, self._repl))
+
+        def apply_grads_fn(params, opt_state, grads):
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._apply_grads_fn = jax.jit(
+            apply_grads_fn, donate_argnums=(0, 1))
+
+    # ------------------------------------------------------------------
+    # rollout inference
+    # ------------------------------------------------------------------
+    def compute_actions(self, obs_batch, state_batches=None, explore=True,
+                        prev_action_batch=None, prev_reward_batch=None):
+        obs = jnp.asarray(obs_batch)
+        actions, logp, dist_inputs, value = self._action_fn(
+            self.params, obs, self._next_rng(), explore)
+        extra = {
+            sb.ACTION_LOGP: np.asarray(logp),
+            sb.ACTION_DIST_INPUTS: np.asarray(dist_inputs),
+            sb.VF_PREDS: np.asarray(value),
+        }
+        if self._extra_action_out_fn is not None:
+            extra.update(self._extra_action_out_fn(self, extra))
+        return np.asarray(actions), [], extra
+
+    def value_function(self, obs_batch):
+        _, value = self.apply(self.params, jnp.asarray(obs_batch))
+        return np.asarray(value)
+
+    # ------------------------------------------------------------------
+    # learning
+    # ------------------------------------------------------------------
+    def _device_batch(self, batch) -> dict:
+        out = {}
+        for k in _DEVICE_COLUMNS:
+            if k in batch:
+                v = np.asarray(batch[k])
+                if v.dtype == np.float64:
+                    v = v.astype(np.float32)
+                if v.dtype == np.bool_:
+                    v = v.astype(np.float32)
+                out[k] = jax.device_put(v, self._bsharded)
+        return out
+
+    def postprocess_trajectory(self, batch, other_agent_batches=None,
+                               episode=None):
+        if self._postprocess_fn is not None:
+            return self._postprocess_fn(self, batch, other_agent_batches,
+                                        episode)
+        return batch
+
+    def learn_on_batch(self, batch) -> Dict:
+        dev_batch = self._device_batch(batch)
+        self.params, self.opt_state, stats = self._train_fn(
+            self.params, self.opt_state, dev_batch, self._next_rng(),
+            self.loss_state)
+        self.global_timestep += batch.count if hasattr(batch, "count") \
+            else len(next(iter(batch.values())))
+        return {k: float(v) for k, v in stats.items()}
+
+    def sgd_learn(self, batch, num_sgd_iter: int, minibatch_size: int) -> Dict:
+        """Whole minibatch-SGD phase as one XLA program (see module doc)."""
+        n = batch.count
+        # Drop the remainder so minibatches tile exactly (same behavior as
+        # the reference's tower loader truncation, multi_gpu_impl.py:116).
+        num_mb = max(1, n // minibatch_size)
+        usable = num_mb * minibatch_size
+        dev_batch = self._device_batch(batch.slice(0, usable))
+        key = (num_sgd_iter, num_mb, minibatch_size)
+        if key not in self._sgd_fns:
+            self._sgd_fns[key] = self._make_sgd_fn(*key)
+        self.params, self.opt_state, stats = self._sgd_fns[key](
+            self.params, self.opt_state, dev_batch, self._next_rng(),
+            self.loss_state)
+        self.global_timestep += n
+        return {k: float(v) for k, v in stats.items()}
+
+    def _make_sgd_fn(self, num_sgd_iter: int, num_mb: int, mb_size: int):
+        def sgd_fn(params, opt_state, batch, rng, loss_state):
+            usable = num_mb * mb_size
+
+            def epoch(carry, erng):
+                params, opt_state = carry
+                perm = jax.random.permutation(erng, usable)
+                shuffled = jax.tree.map(lambda x: x[perm], batch)
+                mbs = jax.tree.map(
+                    lambda x: x.reshape((num_mb, mb_size) + x.shape[1:]),
+                    shuffled)
+
+                def mb_step(carry, mb):
+                    params, opt_state = carry
+                    (loss, stats), grads = jax.value_and_grad(
+                        self._loss_fn, argnums=1, has_aux=True)(
+                            self, params, mb, erng, loss_state)
+                    updates, opt_state = self.optimizer.update(
+                        grads, opt_state, params)
+                    params = optax.apply_updates(params, updates)
+                    stats = dict(stats)
+                    stats["grad_gnorm"] = optax.global_norm(grads)
+                    return (params, opt_state), stats
+
+                (params, opt_state), stats = jax.lax.scan(
+                    mb_step, (params, opt_state), mbs)
+                return (params, opt_state), jax.tree.map(
+                    lambda s: s[-1], stats)  # stats of last minibatch
+
+            rngs = jax.random.split(rng, num_sgd_iter)
+            (params, opt_state), stats = jax.lax.scan(
+                epoch, (params, opt_state), rngs)
+            return params, opt_state, jax.tree.map(lambda s: s[-1], stats)
+
+        return jax.jit(
+            sgd_fn, donate_argnums=(0, 1),
+            in_shardings=(self._repl, self._repl, self._bsharded, self._repl,
+                          self._repl),
+            out_shardings=(self._repl, self._repl, self._repl))
+
+    def compute_gradients(self, batch):
+        dev_batch = self._device_batch(batch)
+        grads, stats = self._grad_fn(self.params, dev_batch,
+                                     self._next_rng(), self.loss_state)
+        host = jax.tree.map(np.asarray, grads)
+        return host, {k: float(v) for k, v in stats.items()}
+
+    def apply_gradients(self, gradients):
+        self.params, self.opt_state = self._apply_grads_fn(
+            self.params, self.opt_state, gradients)
+
+    # ------------------------------------------------------------------
+    # weights
+    # ------------------------------------------------------------------
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = mesh_lib.put_replicated(weights, self.mesh)
+
+    def get_state(self):
+        return {
+            "weights": self.get_weights(),
+            "opt_state": jax.tree.map(np.asarray, self.opt_state),
+            "loss_state": {k: float(v) for k, v in self.loss_state.items()},
+            "global_timestep": self.global_timestep,
+        }
+
+    def set_state(self, state):
+        self.set_weights(state["weights"])
+        self.opt_state = mesh_lib.put_replicated(
+            jax.tree.map(jnp.asarray, state["opt_state"]), self.mesh)
+        self.global_timestep = state.get("global_timestep", 0)
+        for k, v in state.get("loss_state", {}).items():
+            self.loss_state[k] = jnp.asarray(v, jnp.float32)
+
+    def update_loss_state(self, **kwargs) -> None:
+        for k, v in kwargs.items():
+            self.loss_state[k] = jnp.asarray(v, jnp.float32)
+
+    def num_params(self) -> int:
+        return sum(int(np.prod(p.shape))
+                   for p in jax.tree.leaves(self.params))

@@ -1,0 +1,58 @@
+"""Declarative policy factory.
+
+Parity: `rllib/policy/tf_policy_template.py:13` `build_tf_policy` — a policy
+class from a loss function plus optional hooks, the pattern every built-in
+algorithm uses.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from .jax_policy import JaxPolicy
+
+
+def build_jax_policy(name: str,
+                     loss_fn: Callable,
+                     get_default_config: Optional[Callable] = None,
+                     postprocess_fn: Optional[Callable] = None,
+                     extra_action_out_fn: Optional[Callable] = None,
+                     optimizer_fn: Optional[Callable] = None,
+                     make_model: Optional[Callable] = None,
+                     before_init: Optional[Callable] = None,
+                     after_init: Optional[Callable] = None,
+                     mixins: Optional[list] = None):
+    """Returns a JaxPolicy subclass named `name` wired with the hooks."""
+
+    bases = tuple(mixins or []) + (JaxPolicy,)
+
+    def __init__(self, observation_space, action_space, config):
+        cfg = dict(get_default_config() if get_default_config else {})
+        _deep_update(cfg, config)
+        if before_init:
+            before_init(self, observation_space, action_space, cfg)
+        JaxPolicy.__init__(
+            self, observation_space, action_space, cfg,
+            loss_fn=loss_fn,
+            make_model=make_model,
+            optimizer_fn=optimizer_fn,
+            extra_action_out_fn=extra_action_out_fn,
+            postprocess_fn=postprocess_fn)
+        for mixin in (mixins or []):
+            init = getattr(mixin, "mixin_init", None)
+            if init:
+                init(self)
+        if after_init:
+            after_init(self)
+
+    cls = type(name, bases, {"__init__": __init__})
+    return cls
+
+
+def _deep_update(base: dict, new: dict) -> dict:
+    for k, v in (new or {}).items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            _deep_update(base[k], v)
+        else:
+            base[k] = v
+    return base

@@ -1,0 +1,93 @@
+"""Framework-neutral policy interface.
+
+Parity: `rllib/policy/policy.py:27` — compute_actions (:64),
+postprocess_trajectory (:158), learn_on_batch (:183),
+compute/apply_gradients (:202/:214), get/set_weights (:222/:231).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class Policy:
+    def __init__(self, observation_space, action_space, config: dict):
+        self.observation_space = observation_space
+        self.action_space = action_space
+        self.config = config
+
+    def compute_actions(self, obs_batch, state_batches=None,
+                        explore: bool = True,
+                        prev_action_batch=None, prev_reward_batch=None
+                        ) -> Tuple[object, List, Dict]:
+        """Returns (actions, state_out, extra_fetches)."""
+        raise NotImplementedError
+
+    def compute_single_action(self, obs, state=None, explore=True):
+        import numpy as np
+        actions, state_out, extra = self.compute_actions(
+            np.asarray(obs)[None], [s[None] for s in (state or [])],
+            explore=explore)
+        return actions[0], [s[0] for s in state_out], \
+            {k: v[0] for k, v in extra.items()}
+
+    def postprocess_trajectory(self, batch, other_agent_batches=None,
+                               episode=None):
+        return batch
+
+    def learn_on_batch(self, batch) -> Dict:
+        raise NotImplementedError
+
+    def compute_gradients(self, batch) -> Tuple[object, Dict]:
+        raise NotImplementedError
+
+    def apply_gradients(self, gradients) -> None:
+        raise NotImplementedError
+
+    def get_weights(self):
+        raise NotImplementedError
+
+    def set_weights(self, weights) -> None:
+        raise NotImplementedError
+
+    def get_initial_state(self) -> List:
+        return []
+
+    def is_recurrent(self) -> bool:
+        return False
+
+    def get_state(self) -> dict:
+        return {"weights": self.get_weights()}
+
+    def set_state(self, state: dict) -> None:
+        self.set_weights(state["weights"])
+
+    def export_checkpoint(self, path: str) -> None:
+        import pickle
+        with open(path, "wb") as f:
+            pickle.dump(self.get_state(), f)
+
+    def import_checkpoint(self, path: str) -> None:
+        import pickle
+        with open(path, "rb") as f:
+            self.set_state(pickle.load(f))
+
+
+class RandomPolicy(Policy):
+    """Baseline random policy (used by tests and as an example)."""
+
+    def compute_actions(self, obs_batch, state_batches=None, explore=True,
+                        prev_action_batch=None, prev_reward_batch=None):
+        import numpy as np
+        n = len(obs_batch)
+        actions = np.array([self.action_space.sample() for _ in range(n)])
+        return actions, [], {}
+
+    def learn_on_batch(self, batch):
+        return {}
+
+    def get_weights(self):
+        return {}
+
+    def set_weights(self, weights):
+        pass

@@ -1,0 +1,1 @@
+from .filter import FilterManager, MeanStdFilter, NoFilter, get_filter  # noqa: F401

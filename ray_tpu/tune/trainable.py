@@ -1,0 +1,133 @@
+"""Trainable: the iterate/checkpoint unit Tune drives.
+
+Parity: `python/ray/tune/trainable.py` — `train()` (:214) wraps `_train`
+with timing/metadata, `save`/`restore` (:320/:388) wrap `_save`/`_restore`
+with checkpoint bookkeeping.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import tempfile
+import time
+from typing import Dict, Optional
+
+
+class Trainable:
+    def __init__(self, config: Optional[dict] = None, logger_creator=None):
+        self.config = config or {}
+        self._iteration = 0
+        self._timesteps_total = 0
+        self._episodes_total = 0
+        self._time_total = 0.0
+        self._setup_time = time.time()
+        self._logdir = None
+        self._logger = None
+        if logger_creator is not None:
+            self._logger = logger_creator(self.config)
+            self._logdir = getattr(self._logger, "logdir", None)
+        self._setup(self.config)
+
+    # -- subclass hooks --------------------------------------------------
+    def _setup(self, config: dict):
+        pass
+
+    def _train(self) -> Dict:
+        raise NotImplementedError
+
+    def _save(self, checkpoint_dir: str) -> str:
+        raise NotImplementedError
+
+    def _restore(self, checkpoint_path: str):
+        raise NotImplementedError
+
+    def _stop(self):
+        pass
+
+    # -- public API ------------------------------------------------------
+    @property
+    def iteration(self) -> int:
+        return self._iteration
+
+    @property
+    def logdir(self):
+        if self._logdir is None:
+            self._logdir = tempfile.mkdtemp(prefix="trainable_")
+        return self._logdir
+
+    def train(self) -> Dict:
+        start = time.time()
+        result = self._train() or {}
+        self._iteration += 1
+        took = time.time() - start
+        self._time_total += took
+        if "timesteps_this_iter" in result:
+            self._timesteps_total += result["timesteps_this_iter"]
+        if "episodes_this_iter" in result:
+            self._episodes_total += result["episodes_this_iter"]
+        result.setdefault("training_iteration", self._iteration)
+        result.setdefault("timesteps_total", self._timesteps_total)
+        result.setdefault("episodes_total", self._episodes_total)
+        result.setdefault("time_this_iter_s", took)
+        result.setdefault("time_total_s", self._time_total)
+        result.setdefault("done", False)
+        if self._logger is not None:
+            self._logger.on_result(result)
+        return result
+
+    def save(self, checkpoint_dir: Optional[str] = None) -> str:
+        checkpoint_dir = checkpoint_dir or os.path.join(
+            self.logdir, f"checkpoint_{self._iteration}")
+        os.makedirs(checkpoint_dir, exist_ok=True)
+        path = self._save(checkpoint_dir)
+        meta = {"iteration": self._iteration,
+                "timesteps_total": self._timesteps_total,
+                "time_total": self._time_total}
+        with open(path + ".tune_metadata", "wb") as f:
+            pickle.dump(meta, f)
+        return path
+
+    def save_to_object(self) -> bytes:
+        """Checkpoint to an in-memory blob (for over-the-wire restore,
+        parity: `trainable.py:369` save_to_object)."""
+        with tempfile.TemporaryDirectory() as d:
+            path = self.save(d)
+            files = {}
+            for root, _, names in os.walk(d):
+                for n in names:
+                    p = os.path.join(root, n)
+                    files[os.path.relpath(p, d)] = open(p, "rb").read()
+            return pickle.dumps({"files": files,
+                                 "path": os.path.relpath(path, d)})
+
+    def restore(self, checkpoint_path: str):
+        with open(checkpoint_path + ".tune_metadata", "rb") as f:
+            meta = pickle.load(f)
+        self._iteration = meta["iteration"]
+        self._timesteps_total = meta["timesteps_total"]
+        self._time_total = meta["time_total"]
+        self._restore(checkpoint_path)
+
+    def restore_from_object(self, blob: bytes):
+        data = pickle.loads(blob)
+        with tempfile.TemporaryDirectory() as d:
+            for rel, content in data["files"].items():
+                p = os.path.join(d, rel)
+                os.makedirs(os.path.dirname(p), exist_ok=True)
+                with open(p, "wb") as f:
+                    f.write(content)
+            self.restore(os.path.join(d, data["path"]))
+
+    def stop(self):
+        if self._logger is not None:
+            self._logger.close()
+        self._stop()
+
+    @classmethod
+    def default_resource_request(cls, config: dict):
+        return None
+
+    @classmethod
+    def resource_help(cls, config: dict) -> str:
+        return ""
