@@ -1,0 +1,87 @@
+"""Headline benchmark: RL learner throughput (timesteps/s/chip).
+
+Mirrors the reference's north-star number — RLlib IMPALA learner
+throughput, ~30k transitions/s on 2×V100 = 15k/s per accelerator
+(`doc/source/rllib-algorithms.rst:90-91`, BASELINE.md). Here the learner
+step is the TPU-native PPO/IMPALA update: one donated-buffer XLA program
+doing the full minibatch-SGD phase on an Atari-shaped batch
+(84x84x4 uint8 frames, Nature CNN), on however many local chips exist.
+
+Measured in steady state with the batch staged on-device, i.e. the
+throughput of the compiled learner program itself — in production the
+host→device feed is double-buffered behind the update (SURVEY.md §7.4#4),
+and on this harness the chip sits behind a ~100 MB/s tunnel that would
+otherwise swamp the measurement with an artifact of the test rig.
+
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+BASELINE_PER_CHIP = 15000.0  # transitions/s/chip (2xV100 -> 30k total)
+
+
+def main():
+    import jax
+    from __graft_entry__ import _synthetic_ppo_batch
+    from ray_tpu.parallel import mesh as mesh_lib
+    from ray_tpu.rllib.agents.ppo.ppo import DEFAULT_CONFIG, PPOJaxPolicy
+    from ray_tpu.rllib.env.spaces import Box, Discrete
+
+    devices = jax.devices()
+    n_dev = len(devices)
+    mesh = mesh_lib.make_mesh(devices=devices, axis_names=("dp",))
+
+    num_actions = 6
+    obs_shape = (84, 84, 4)
+    batch_size = 1024 * n_dev
+    num_sgd_iter = 1
+    minibatch = 256 * n_dev
+
+    config = dict(DEFAULT_CONFIG)
+    config.update({"_mesh": mesh})
+    policy = PPOJaxPolicy(
+        Box(low=0, high=255, shape=obs_shape, dtype=np.uint8),
+        Discrete(num_actions), config)
+
+    batch = _synthetic_ppo_batch(batch_size, obs_shape, num_actions,
+                                 obs_dtype=np.uint8)
+
+    # Stage the batch on device and grab the compiled update program.
+    dev_batch = policy._device_batch(batch)
+    num_mb = batch_size // minibatch
+    update = policy._make_sgd_fn(num_sgd_iter, num_mb, minibatch)
+    rng = jax.random.PRNGKey(0)
+
+    params, opt_state = policy.params, policy.opt_state
+    # Warmup / compile.
+    for _ in range(3):
+        params, opt_state, stats = update(params, opt_state, dev_batch, rng,
+                                          policy.loss_state)
+    jax.block_until_ready(params)
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, stats = update(params, opt_state, dev_batch, rng,
+                                          policy.loss_state)
+    jax.block_until_ready(params)
+    dt = time.perf_counter() - t0
+
+    ts_per_s = iters * batch_size / dt
+    per_chip = ts_per_s / n_dev
+    print(json.dumps({
+        "metric": "learner_throughput_per_chip",
+        "value": round(per_chip, 1),
+        "unit": "timesteps/s/chip",
+        "vs_baseline": round(per_chip / BASELINE_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -53,6 +53,17 @@ class Preprocessor:
             return out
         return np.asarray(obs, dtype=self.dtype)
 
+    @property
+    def is_identity(self) -> bool:
+        return not isinstance(self.obs_space, Discrete)
+
+    def transform_batch(self, obs):
+        """Vectorized transform for a [num_envs, ...] stack of raw obs."""
+        if isinstance(self.obs_space, Discrete):
+            idx = np.asarray(obs, dtype=np.int64)
+            return np.eye(self.obs_space.n, dtype=np.float32)[idx]
+        return np.asarray(obs, dtype=self.dtype)
+
 
 def get_preprocessor(obs_space) -> Preprocessor:
     return Preprocessor(obs_space)
@@ -70,11 +81,13 @@ def get_model(obs_space, num_outputs: int, model_config: dict = None):
     cfg = dict(MODEL_DEFAULTS)
     cfg.update(model_config or {})
     if cfg["use_lstm"]:
-        return LSTMNetwork(
-            num_outputs=num_outputs,
-            cell_size=cfg["lstm_cell_size"],
-            hiddens=tuple(cfg["fcnet_hiddens"][:1]) or (256,),
-            activation=cfg["fcnet_activation"])
+        # LSTMNetwork takes (obs[B,T], state, reset_mask); the feedforward
+        # JaxPolicy can't drive it — recurrent rollouts/training need the
+        # recurrent policy path (rnn_sequencing parity), not silent misuse.
+        raise NotImplementedError(
+            "use_lstm=True requires a recurrent policy (construct "
+            "LSTMNetwork via make_model= and handle state explicitly); "
+            "feedforward JaxPolicy cannot drive it")
     if is_image_space(obs_space):
         filters = cfg["conv_filters"] or ((32, 8, 4), (64, 4, 2), (64, 3, 1))
         return VisionNetwork(

@@ -63,18 +63,12 @@ COMMON_CONFIG = {
 }
 
 
+from ..utils.config import deep_merge  # noqa: E402  (re-export)
+
+
 def with_common_config(extra: dict) -> dict:
     cfg = deep_merge({}, COMMON_CONFIG)
     return deep_merge(cfg, extra)
-
-
-def deep_merge(base: dict, new: dict) -> dict:
-    for k, v in (new or {}).items():
-        if isinstance(v, dict) and isinstance(base.get(k), dict):
-            deep_merge(base[k], v)
-        else:
-            base[k] = v
-    return base
 
 
 class Trainer(Trainable):
@@ -104,16 +98,17 @@ class Trainer(Trainable):
         self._init(merged, self.env_creator)
 
     def _make_mesh(self):
-        """Build the learner mesh (TPU devices if present)."""
+        """Build the learner mesh. Requesting more devices than exist is
+        an error, not a silent single-device fallback."""
+        import jax
         from ...parallel import mesh as mesh_lib
         n = self.config.get("num_tpus_for_learner") or 0
-        try:
-            if n:
-                self.learner_mesh = mesh_lib.make_mesh(num_devices=n)
-            else:
-                self.learner_mesh = mesh_lib.make_mesh(num_devices=1)
-        except Exception:
-            self.learner_mesh = None
+        available = len(jax.devices())
+        if n > available:
+            raise ValueError(
+                f"num_tpus_for_learner={n} but only {available} device(s) "
+                f"visible to this process")
+        self.learner_mesh = mesh_lib.make_mesh(num_devices=n or 1)
 
     def _init(self, config, env_creator):
         """Subclasses/templates build workers + optimizer here."""

@@ -34,7 +34,8 @@ class RolloutWorker:
                  seed: Optional[int] = None,
                  observation_filter: str = "NoFilter",
                  explore: bool = True,
-                 env_config: Optional[dict] = None):
+                 env_config: Optional[dict] = None,
+                 horizon: Optional[int] = None):
         self.worker_index = worker_index
         env_config = dict(env_config or {})
         env_config["worker_index"] = worker_index
@@ -47,8 +48,13 @@ class RolloutWorker:
             cfg["seed"] = seed + worker_index
         self.policy = policy_cls(
             self.env.observation_space, self.env.action_space, cfg)
+        # Filter shapes follow the preprocessed obs (Discrete -> one-hot);
+        # policies without a preprocessor (e.g. RandomPolicy) filter raw obs.
+        self.preprocessor = getattr(self.policy, "preprocessor", None)
         self.obs_filter = get_filter(
-            observation_filter, self.env.observation_space.shape)
+            observation_filter,
+            self.preprocessor.shape if self.preprocessor is not None
+            else self.env.observation_space.shape)
 
         gamma = cfg.get("gamma", 0.99)
         lambda_ = cfg.get("lambda", 1.0)
@@ -73,7 +79,9 @@ class RolloutWorker:
             postprocess_fn=postprocess,
             obs_filter=self.obs_filter if observation_filter != "NoFilter"
             else None,
-            explore=explore)
+            explore=explore,
+            horizon=horizon,
+            preprocessor=self.preprocessor)
 
     # -- sampling --------------------------------------------------------
     def sample(self) -> SampleBatch:

@@ -66,7 +66,8 @@ class SyncSampler:
                  obs_filter: Optional[Callable] = None,
                  explore: bool = True,
                  include_infos: bool = False,
-                 horizon: Optional[int] = None):
+                 horizon: Optional[int] = None,
+                 preprocessor=None):
         self.env = vector_env
         self.policy = policy
         self.T = rollout_fragment_length
@@ -75,11 +76,26 @@ class SyncSampler:
         self.explore = explore
         self.include_infos = include_infos
         self.horizon = horizon
+        # Space preprocessor (one-hot for Discrete obs etc.); identity
+        # preprocessors are skipped entirely.
+        self.preprocessor = preprocessor if (
+            preprocessor is not None
+            and not getattr(preprocessor, "is_identity", False)) else None
         self._eps_counter = 0
-        self._obs = self._filter(self.env.reset())
+        self._obs = self._filter(self._preprocess(self.env.reset()))
         self._builders = [self._new_builder()
                           for _ in range(self.env.num_envs)]
         self.metrics: List[RolloutMetrics] = []
+
+    def _preprocess(self, obs):
+        if self.preprocessor is not None:
+            return self.preprocessor.transform_batch(obs)
+        return obs
+
+    def _preprocess_one(self, obs):
+        if self.preprocessor is not None:
+            return self.preprocessor.transform(obs)
+        return obs
 
     def _filter(self, obs):
         if self.obs_filter is not None:
@@ -97,14 +113,18 @@ class SyncSampler:
             actions, _, extra = self.policy.compute_actions(
                 obs, explore=self.explore)
             next_obs, rewards, dones, infos = self.env.step(actions)
-            next_obs = self._filter(next_obs)
+            next_obs = self._filter(self._preprocess(next_obs))
             for i in range(self.env.num_envs):
                 b = self._builders[i]
+                # Horizon truncation is terminal: the chunk is postprocessed
+                # with a zero bootstrap, so the row must carry done=True.
+                hit_horizon = bool(
+                    self.horizon and b.ep_len + 1 >= self.horizon)
                 row = {
                     sb.OBS: obs[i],
                     sb.ACTIONS: actions[i],
                     sb.REWARDS: np.float32(rewards[i]),
-                    sb.DONES: bool(dones[i]),
+                    sb.DONES: bool(dones[i]) or hit_horizon,
                     sb.NEW_OBS: next_obs[i],
                     sb.AGENT_INDEX: i,
                     sb.T: b.ep_len,
@@ -116,7 +136,7 @@ class SyncSampler:
                 b.add(**row)
                 b.ep_reward += float(rewards[i])
                 b.ep_len += 1
-                if dones[i] or (self.horizon and b.ep_len >= self.horizon):
+                if dones[i] or hit_horizon:
                     self.metrics.append(
                         RolloutMetrics(b.ep_len, b.ep_reward))
                     chunk = b.build()
@@ -124,9 +144,9 @@ class SyncSampler:
                         chunk = self.postprocess_fn(chunk, None)
                     chunks.append(chunk)
                     self._builders[i] = self._new_builder()
-                    next_obs[i] = self.env.reset_at(i) \
-                        if self.obs_filter is None \
-                        else self.obs_filter(self.env.reset_at(i))
+                    fresh = self._preprocess_one(self.env.reset_at(i))
+                    next_obs[i] = fresh if self.obs_filter is None \
+                        else self.obs_filter(fresh)
             self._obs = next_obs
         # Fragment boundary: flush partial trajectories with bootstrap obs.
         for i in range(self.env.num_envs):

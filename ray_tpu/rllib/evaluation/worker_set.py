@@ -36,7 +36,8 @@ class WorkerSet:
             worker_index=0,
             seed=config.get("seed"),
             observation_filter=config.get("observation_filter", "NoFilter"),
-            env_config=config.get("env_config"))
+            env_config=config.get("env_config"),
+            horizon=config.get("horizon"))
         self.remote_workers: List = []
         if num_workers > 0:
             self._remote_cls = ray_tpu.remote(RolloutWorker)
@@ -61,7 +62,8 @@ class WorkerSet:
                 worker_index=index,
                 seed=cfg.get("seed"),
                 observation_filter=cfg.get("observation_filter", "NoFilter"),
-                env_config=cfg.get("env_config"))
+                env_config=cfg.get("env_config"),
+                horizon=cfg.get("horizon"))
 
     # ------------------------------------------------------------------
     def sync_weights(self):
@@ -72,6 +74,19 @@ class WorkerSet:
         weights = ray_tpu.put(self.local_worker.get_weights())
         ray_tpu.get([w.set_weights.remote(weights)
                      for w in self.remote_workers])
+
+    def sync_filters(self):
+        """Merge remote MeanStdFilter deltas into the local filter and
+        push the result back (parity: `FilterManager.synchronize`,
+        `rllib/utils/filter_manager.py:14`)."""
+        from ..utils.filter import FilterManager, NoFilter
+        if not self.remote_workers or isinstance(
+                self.local_worker.obs_filter, NoFilter):
+            return
+        FilterManager.synchronize(
+            self.local_worker.obs_filter, self.remote_workers,
+            get_ref=lambda w: w.get_filters.remote(flush_after=True),
+            sync_call=lambda w, f: w.sync_filters.remote(f))
 
     def recreate_failed_worker(self, worker):
         """Replace a dead remote worker (reference: `ignore_worker_failures`

@@ -48,6 +48,7 @@ class SyncSamplesOptimizer(PolicyOptimizer):
     def step(self) -> dict:
         self.workers.sync_weights()
         batch = collect_train_batch(self.workers, self.train_batch_size)
+        self.workers.sync_filters()
         self.learner_stats = self.workers.local_worker.learn_on_batch(batch)
         self.num_steps_sampled += batch.count
         self.num_steps_trained += batch.count
@@ -71,6 +72,7 @@ class MultiDeviceOptimizer(PolicyOptimizer):
         import numpy as np
         self.workers.sync_weights()
         batch = collect_train_batch(self.workers, self.train_batch_size)
+        self.workers.sync_filters()
         for field in self.standardize_fields:
             if field in batch:
                 v = batch[field]

@@ -132,6 +132,7 @@ class JaxPolicy(Policy):
             return actions, logp, dist_inputs, value
 
         self._action_fn = jax.jit(action_fn)
+        self._value_fn = jax.jit(lambda params, obs: self.apply(params, obs)[1])
 
         def loss_and_grad(params, batch, rng, loss_state):
             (loss, stats), grads = jax.value_and_grad(
@@ -190,8 +191,7 @@ class JaxPolicy(Policy):
         return np.asarray(actions), [], extra
 
     def value_function(self, obs_batch):
-        _, value = self.apply(self.params, jnp.asarray(obs_batch))
-        return np.asarray(value)
+        return np.asarray(self._value_fn(self.params, jnp.asarray(obs_batch)))
 
     # ------------------------------------------------------------------
     # learning

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 from typing import Callable, Optional
 
+from ..utils.config import deep_merge
 from .jax_policy import JaxPolicy
 
 
@@ -27,8 +28,9 @@ def build_jax_policy(name: str,
     bases = tuple(mixins or []) + (JaxPolicy,)
 
     def __init__(self, observation_space, action_space, config):
-        cfg = dict(get_default_config() if get_default_config else {})
-        _deep_update(cfg, config)
+        cfg = deep_merge(
+            {}, get_default_config() if get_default_config else {})
+        deep_merge(cfg, config)
         if before_init:
             before_init(self, observation_space, action_space, cfg)
         JaxPolicy.__init__(
@@ -47,12 +49,3 @@ def build_jax_policy(name: str,
 
     cls = type(name, bases, {"__init__": __init__})
     return cls
-
-
-def _deep_update(base: dict, new: dict) -> dict:
-    for k, v in (new or {}).items():
-        if isinstance(v, dict) and isinstance(base.get(k), dict):
-            _deep_update(base[k], v)
-        else:
-            base[k] = v
-    return base

@@ -25,6 +25,9 @@ class Policy:
 
     def compute_single_action(self, obs, state=None, explore=True):
         import numpy as np
+        pre = getattr(self, "preprocessor", None)
+        if pre is not None and not getattr(pre, "is_identity", True):
+            obs = pre.transform(obs)
         actions, state_out, extra = self.compute_actions(
             np.asarray(obs)[None], [s[None] for s in (state or [])],
             explore=explore)

@@ -1,0 +1,21 @@
+"""Config dict merging (parity: `rllib/utils/__init__.py` deep_update /
+`merge_dicts`). Nested dicts are copied, never aliased, so merging user
+config can never write through into shared module-level defaults."""
+
+from __future__ import annotations
+
+
+def deep_merge(base: dict, new: dict) -> dict:
+    """Recursively merge `new` into `base` (in place) and return `base`.
+
+    Dict values from `new` are deep-copied on assignment so `base` never
+    shares nested-dict structure with `new`.
+    """
+    for k, v in (new or {}).items():
+        if isinstance(v, dict) and isinstance(base.get(k), dict):
+            deep_merge(base[k], v)
+        elif isinstance(v, dict):
+            base[k] = deep_merge({}, v)
+        else:
+            base[k] = v
+    return base
