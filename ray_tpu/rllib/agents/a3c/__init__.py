@@ -1,0 +1,2 @@
+from .a3c import (A2C_DEFAULT_CONFIG, A2CTrainer, A3CJaxPolicy,  # noqa: F401
+                  A3CTrainer, DEFAULT_CONFIG)

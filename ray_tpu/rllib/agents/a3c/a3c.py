@@ -1,0 +1,76 @@
+"""A3C / A2C: (a)synchronous advantage actor-critic.
+
+Parity: `rllib/agents/a3c/` — shared actor-critic loss; A3C applies
+worker gradients asynchronously (`AsyncGradientsOptimizer`), A2C is the
+synchronous variant over `SyncSamplesOptimizer`.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ... import sample_batch as sb
+from ...optimizers.async_gradients_optimizer import AsyncGradientsOptimizer
+from ...optimizers.sync_samples_optimizer import SyncSamplesOptimizer
+from ...policy.jax_policy_template import build_jax_policy
+from ..trainer import with_common_config
+from ..trainer_template import build_trainer
+
+DEFAULT_CONFIG = with_common_config({
+    "lr": 0.0001,
+    "gamma": 0.99,
+    "use_gae": True,
+    "lambda": 1.0,
+    "grad_clip": 40.0,
+    "vf_loss_coeff": 0.5,
+    "entropy_coeff": 0.01,
+    "rollout_fragment_length": 10,
+    "train_batch_size": 200,
+    "min_iter_time_s": 5,
+    "num_workers": 2,
+})
+
+A2C_DEFAULT_CONFIG = dict(DEFAULT_CONFIG, rollout_fragment_length=20,
+                          min_iter_time_s=10)
+
+
+def a3c_loss(policy, params, batch, rng, loss_state):
+    cfg = policy.config
+    dist_inputs, value = policy.apply(params, batch[sb.OBS])
+    dist = policy.dist_class(dist_inputs)
+    logp = dist.logp(batch[sb.ACTIONS])
+    adv = batch[sb.ADVANTAGES]
+    pi_loss = -jnp.sum(logp * adv)
+    delta = value - batch[sb.VALUE_TARGETS]
+    vf_loss = 0.5 * jnp.sum(delta ** 2)
+    entropy = jnp.sum(dist.entropy())
+    total = (pi_loss
+             + cfg["vf_loss_coeff"] * vf_loss
+             - cfg["entropy_coeff"] * entropy)
+    n = logp.shape[0]
+    stats = {
+        "total_loss": total,
+        "policy_loss": pi_loss / n,
+        "vf_loss": vf_loss / n,
+        "entropy": entropy / n,
+    }
+    return total, stats
+
+
+A3CJaxPolicy = build_jax_policy(
+    "A3CJaxPolicy", a3c_loss, get_default_config=lambda: DEFAULT_CONFIG)
+
+
+A3CTrainer = build_trainer(
+    name="A3C",
+    default_policy=A3CJaxPolicy,
+    default_config=DEFAULT_CONFIG,
+    make_policy_optimizer=lambda workers, config: AsyncGradientsOptimizer(
+        workers, grads_per_step=config.get("grads_per_step", 100)))
+
+A2CTrainer = build_trainer(
+    name="A2C",
+    default_policy=A3CJaxPolicy,
+    default_config=A2C_DEFAULT_CONFIG,
+    make_policy_optimizer=lambda workers, config: SyncSamplesOptimizer(
+        workers, train_batch_size=config["train_batch_size"]))

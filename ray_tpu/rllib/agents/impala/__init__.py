@@ -1,0 +1,2 @@
+from .impala import DEFAULT_CONFIG, IMPALATrainer  # noqa: F401
+from .vtrace_policy import VTraceJaxPolicy  # noqa: F401

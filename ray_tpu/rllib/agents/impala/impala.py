@@ -1,0 +1,52 @@
+"""IMPALA: Importance-Weighted Actor-Learner Architecture.
+
+Parity: `rllib/agents/impala/impala.py:109` — V-trace policy +
+`AsyncSamplesOptimizer`. The TPU learner owns the device mesh; CPU actor
+workers stream packed fragments; weights broadcast back through the
+object store (Podracer/Sebulba split).
+"""
+
+from __future__ import annotations
+
+from ...optimizers.async_samples_optimizer import AsyncSamplesOptimizer
+from ..trainer_template import build_trainer
+from .vtrace_policy import DEFAULT_CONFIG, VTraceJaxPolicy
+
+
+def make_async_optimizer(workers, config):
+    return AsyncSamplesOptimizer(
+        workers,
+        train_batch_size=config["train_batch_size"],
+        rollout_fragment_length=config["rollout_fragment_length"],
+        max_sample_requests_in_flight_per_worker=config[
+            "max_sample_requests_in_flight_per_worker"],
+        broadcast_interval=config["broadcast_interval"],
+        learner_queue_size=config["learner_queue_size"],
+        num_sgd_iter=config["num_sgd_iter"],
+        sgd_minibatch_size=config.get("sgd_minibatch_size", 0),
+        # Minibatches shuffle/slice at fragment granularity so V-trace's
+        # [B, T] reshape stays valid.
+        sgd_sequence_length=config["rollout_fragment_length"])
+
+
+def validate_config(config):
+    if config["train_batch_size"] % config["rollout_fragment_length"] != 0:
+        raise ValueError(
+            "train_batch_size must be a multiple of "
+            "rollout_fragment_length (V-trace sequences reshape to "
+            "[B, T] with no padding)")
+    mb = config.get("sgd_minibatch_size", 0)
+    if mb and mb % config["rollout_fragment_length"] != 0:
+        raise ValueError(
+            "sgd_minibatch_size must be a multiple of "
+            "rollout_fragment_length")
+    if not config.get("pack_fragments", True):
+        raise ValueError("IMPALA requires pack_fragments=True")
+
+
+IMPALATrainer = build_trainer(
+    name="IMPALA",
+    default_policy=VTraceJaxPolicy,
+    default_config=DEFAULT_CONFIG,
+    make_policy_optimizer=make_async_optimizer,
+    validate_config=validate_config)

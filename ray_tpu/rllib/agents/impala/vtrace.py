@@ -1,0 +1,98 @@
+"""V-trace off-policy actor-critic targets (IMPALA).
+
+Parity: `rllib/agents/impala/vtrace.py:141,272` (`multi_from_logits`,
+`from_importance_weights`), itself the DeepMind reference implementation.
+
+TPU re-architecture: the recursive backward pass is a `jax.lax.scan` over
+the time axis (the reference used `tf.scan` on reversed sequences); the
+whole target computation fuses into the learner's update program instead
+of running as a separate graph. All inputs are time-major [T, B].
+"""
+
+from __future__ import annotations
+
+import collections
+
+import jax
+import jax.numpy as jnp
+
+VTraceReturns = collections.namedtuple("VTraceReturns", ["vs", "pg_advantages"])
+
+
+def from_importance_weights(log_rhos,
+                            discounts,
+                            rewards,
+                            values,
+                            bootstrap_value,
+                            clip_rho_threshold: float = 1.0,
+                            clip_pg_rho_threshold: float = 1.0,
+                            lambda_: float = 1.0):
+    """V-trace targets from log importance weights.
+
+    Args (all time-major):
+      log_rhos: [T, B] log(pi_target(a|x) / pi_behaviour(a|x)).
+      discounts: [T, B] discount at each step (0 at terminal steps).
+      rewards, values: [T, B].
+      bootstrap_value: [B] value estimate for the state after step T-1.
+
+    Returns VTraceReturns(vs=[T, B], pg_advantages=[T, B]); both are
+    fixed-point targets — callers must not differentiate through them
+    (use `jax.lax.stop_gradient`).
+    """
+    rhos = jnp.exp(log_rhos)
+    clipped_rhos = jnp.minimum(clip_rho_threshold, rhos) \
+        if clip_rho_threshold is not None else rhos
+    cs = lambda_ * jnp.minimum(1.0, rhos)
+
+    # values_t_plus_1[t] = V(x_{t+1}); bootstrap closes the sequence.
+    values_t_plus_1 = jnp.concatenate(
+        [values[1:], bootstrap_value[None]], axis=0)
+    deltas = clipped_rhos * (
+        rewards + discounts * values_t_plus_1 - values)
+
+    def backward(acc, xs):
+        delta, discount, c = xs
+        acc = delta + discount * c * acc
+        return acc, acc
+
+    _, vs_minus_v_xs = jax.lax.scan(
+        backward, jnp.zeros_like(bootstrap_value),
+        (deltas, discounts, cs), reverse=True)
+    vs = vs_minus_v_xs + values
+
+    # Advantage for the policy gradient.
+    vs_t_plus_1 = jnp.concatenate([vs[1:], bootstrap_value[None]], axis=0)
+    clipped_pg_rhos = jnp.minimum(clip_pg_rho_threshold, rhos) \
+        if clip_pg_rho_threshold is not None else rhos
+    pg_advantages = clipped_pg_rhos * (
+        rewards + discounts * vs_t_plus_1 - values)
+    return VTraceReturns(vs=vs, pg_advantages=pg_advantages)
+
+
+def from_logits(behaviour_policy_logits,
+                target_policy_logits,
+                actions,
+                discounts,
+                rewards,
+                values,
+                bootstrap_value,
+                dist_class,
+                clip_rho_threshold: float = 1.0,
+                clip_pg_rho_threshold: float = 1.0,
+                lambda_: float = 1.0):
+    """V-trace from behaviour/target distribution parameters.
+
+    Parity: `vtrace.multi_from_logits` collapsed to the single-action-space
+    case; `dist_class` is any distributions.py class (Categorical for the
+    Atari north star, DiagGaussian for continuous control).
+    """
+    behaviour_logp = dist_class(behaviour_policy_logits).logp(actions)
+    target_logp = dist_class(target_policy_logits).logp(actions)
+    log_rhos = target_logp - behaviour_logp
+    returns = from_importance_weights(
+        log_rhos=log_rhos, discounts=discounts, rewards=rewards,
+        values=values, bootstrap_value=bootstrap_value,
+        clip_rho_threshold=clip_rho_threshold,
+        clip_pg_rho_threshold=clip_pg_rho_threshold,
+        lambda_=lambda_)
+    return returns, log_rhos, target_logp

@@ -11,9 +11,27 @@ def _ppo():
     return PPOTrainer
 
 
+def _impala():
+    from .impala import IMPALATrainer
+    return IMPALATrainer
+
+
+def _a3c():
+    from .a3c import A3CTrainer
+    return A3CTrainer
+
+
+def _a2c():
+    from .a3c import A2CTrainer
+    return A2CTrainer
+
+
 ALGORITHMS = {
     "PG": _pg,
     "PPO": _ppo,
+    "IMPALA": _impala,
+    "A3C": _a3c,
+    "A2C": _a2c,
 }
 
 

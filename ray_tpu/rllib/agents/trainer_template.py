@@ -49,9 +49,22 @@ def build_trainer(name: str,
                 after_init(self)
 
         def _train_inner(self):
+            import time
             if before_train_step:
                 before_train_step(self)
-            fetches = self.optimizer.step()
+            # Iteration pacing (parity: trainer_template.py:117-135): keep
+            # stepping the optimizer until both min_iter_time_s and
+            # timesteps_per_iteration are satisfied.
+            start = time.monotonic()
+            steps0 = self.optimizer.num_steps_sampled
+            min_time = self.config.get("min_iter_time_s") or 0
+            min_steps = self.config.get("timesteps_per_iteration") or 0
+            while True:
+                fetches = self.optimizer.step()
+                if (time.monotonic() - start >= min_time
+                        and self.optimizer.num_steps_sampled - steps0
+                        >= min_steps):
+                    break
             if after_optimizer_step:
                 after_optimizer_step(self, fetches)
             result = self._result_from_optimizer(self.optimizer)

@@ -35,7 +35,8 @@ class RolloutWorker:
                  observation_filter: str = "NoFilter",
                  explore: bool = True,
                  env_config: Optional[dict] = None,
-                 horizon: Optional[int] = None):
+                 horizon: Optional[int] = None,
+                 pack_fragments: bool = False):
         self.worker_index = worker_index
         env_config = dict(env_config or {})
         env_config["worker_index"] = worker_index
@@ -76,12 +77,15 @@ class RolloutWorker:
 
         self.sampler = SyncSampler(
             self.env, self.policy, rollout_fragment_length,
-            postprocess_fn=postprocess,
+            # Packed fragments (IMPALA/V-trace) compute targets on the
+            # learner; GAE postprocessing only applies to episode chunks.
+            postprocess_fn=None if pack_fragments else postprocess,
             obs_filter=self.obs_filter if observation_filter != "NoFilter"
             else None,
             explore=explore,
             horizon=horizon,
-            preprocessor=self.preprocessor)
+            preprocessor=self.preprocessor,
+            pack_fragments=pack_fragments)
 
     # -- sampling --------------------------------------------------------
     def sample(self) -> SampleBatch:
@@ -97,6 +101,13 @@ class RolloutWorker:
 
     def compute_gradients(self, batch):
         return self.policy.compute_gradients(batch)
+
+    def sample_and_compute_grads(self):
+        """One fragment + its gradients (A3C's per-worker unit of work;
+        parity: `a3c.py` sample-then-grad remote call chain)."""
+        batch = self.sample()
+        grads, stats = self.policy.compute_gradients(batch)
+        return grads, stats, batch.count
 
     def apply_gradients(self, grads):
         return self.policy.apply_gradients(grads)

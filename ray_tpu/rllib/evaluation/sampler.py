@@ -24,17 +24,19 @@ RolloutMetrics = collections.namedtuple(
 class _EpisodeBuilder:
     """Accumulates one env slot's current episode fragment."""
 
-    __slots__ = ("columns", "eps_id", "ep_reward", "ep_len")
+    __slots__ = ("columns", "eps_id", "ep_reward", "ep_len", "_eps_ids")
 
     def __init__(self, eps_id: int):
         self.columns = collections.defaultdict(list)
         self.eps_id = eps_id
         self.ep_reward = 0.0
         self.ep_len = 0
+        self._eps_ids = []
 
     def add(self, **row):
         for k, v in row.items():
             self.columns[k].append(v)
+        self._eps_ids.append(self.eps_id)
 
     def count(self):
         return len(self.columns[sb.OBS])
@@ -47,8 +49,7 @@ class _EpisodeBuilder:
             else:
                 out[k] = np.stack(v) if isinstance(v[0], np.ndarray) \
                     else np.asarray(v)
-        n = len(out[sb.OBS])
-        out[sb.EPS_ID] = np.full(n, self.eps_id, dtype=np.int64)
+        out[sb.EPS_ID] = np.asarray(self._eps_ids, dtype=np.int64)
         return SampleBatch(out)
 
 
@@ -67,7 +68,8 @@ class SyncSampler:
                  explore: bool = True,
                  include_infos: bool = False,
                  horizon: Optional[int] = None,
-                 preprocessor=None):
+                 preprocessor=None,
+                 pack_fragments: bool = False):
         self.env = vector_env
         self.policy = policy
         self.T = rollout_fragment_length
@@ -76,6 +78,12 @@ class SyncSampler:
         self.explore = explore
         self.include_infos = include_infos
         self.horizon = horizon
+        # pack_fragments=True: every env slot emits exactly T contiguous
+        # steps per sample(), crossing episode boundaries (dones mark the
+        # resets inside). This is the V-trace/IMPALA layout — sequences
+        # reshape to [B, T] with no padding (reference: `_env_runner`
+        # pack mode, `rllib/evaluation/sampler.py:226`).
+        self.pack_fragments = pack_fragments
         # Space preprocessor (one-hot for Discrete obs etc.); identity
         # preprocessors are skipped entirely.
         self.preprocessor = preprocessor if (
@@ -139,11 +147,17 @@ class SyncSampler:
                 if dones[i] or hit_horizon:
                     self.metrics.append(
                         RolloutMetrics(b.ep_len, b.ep_reward))
-                    chunk = b.build()
-                    if self.postprocess_fn is not None:
-                        chunk = self.postprocess_fn(chunk, None)
-                    chunks.append(chunk)
-                    self._builders[i] = self._new_builder()
+                    if self.pack_fragments:
+                        # Keep filling the same fragment across the reset.
+                        self._eps_counter += 1
+                        b.eps_id = self._eps_counter
+                        b.ep_reward, b.ep_len = 0.0, 0
+                    else:
+                        chunk = b.build()
+                        if self.postprocess_fn is not None:
+                            chunk = self.postprocess_fn(chunk, None)
+                        chunks.append(chunk)
+                        self._builders[i] = self._new_builder()
                     fresh = self._preprocess_one(self.env.reset_at(i))
                     next_obs[i] = fresh if self.obs_filter is None \
                         else self.obs_filter(fresh)

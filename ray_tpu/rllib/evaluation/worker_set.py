@@ -37,7 +37,8 @@ class WorkerSet:
             seed=config.get("seed"),
             observation_filter=config.get("observation_filter", "NoFilter"),
             env_config=config.get("env_config"),
-            horizon=config.get("horizon"))
+            horizon=config.get("horizon"),
+            pack_fragments=config.get("pack_fragments", False))
         self.remote_workers: List = []
         if num_workers > 0:
             self._remote_cls = ray_tpu.remote(RolloutWorker)
@@ -63,7 +64,8 @@ class WorkerSet:
                 seed=cfg.get("seed"),
                 observation_filter=cfg.get("observation_filter", "NoFilter"),
                 env_config=cfg.get("env_config"),
-                horizon=cfg.get("horizon"))
+                horizon=cfg.get("horizon"),
+                pack_fragments=cfg.get("pack_fragments", False))
 
     # ------------------------------------------------------------------
     def sync_weights(self):

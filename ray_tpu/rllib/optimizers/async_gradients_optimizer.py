@@ -1,0 +1,47 @@
+"""Async gradient application (A3C).
+
+Parity: `rllib/optimizers/async_gradients_optimizer.py` — each worker
+samples and computes gradients on its own policy copy; the driver applies
+them to the learner policy as they arrive (stale by design) and ships
+fresh weights back to that worker only.
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+
+from ..utils.actors import TaskPool
+from .policy_optimizer import PolicyOptimizer
+
+
+class AsyncGradientsOptimizer(PolicyOptimizer):
+    def __init__(self, workers, grads_per_step: int = 100):
+        super().__init__(workers)
+        self.grads_per_step = grads_per_step
+        self.learner_stats = {}
+        if not workers.remote_workers:
+            raise ValueError(
+                "AsyncGradientsOptimizer requires num_workers > 0")
+        self.grad_tasks = TaskPool()
+        weights = ray_tpu.put(self.workers.local_worker.get_weights())
+        for w in self.workers.remote_workers:
+            w.set_weights.remote(weights)
+            self.grad_tasks.add(w, w.sample_and_compute_grads.remote())
+
+    def step(self) -> dict:
+        applied = 0
+        while applied < self.grads_per_step:
+            for worker, ref in self.grad_tasks.completed(blocking_wait=True):
+                grads, stats, count = ray_tpu.get(ref)
+                self.workers.local_worker.apply_gradients(grads)
+                self.learner_stats = stats
+                self.num_steps_sampled += count
+                self.num_steps_trained += count
+                applied += 1
+                worker.set_weights.remote(ray_tpu.put(
+                    self.workers.local_worker.get_weights()))
+                self.grad_tasks.add(
+                    worker, worker.sample_and_compute_grads.remote())
+                if applied >= self.grads_per_step:
+                    break
+        return self.learner_stats

@@ -1,0 +1,238 @@
+"""IMPALA-style async optimizer: decoupled sampling and learning.
+
+Parity: `rllib/optimizers/async_samples_optimizer.py:19`
+(`AsyncSamplesOptimizer`), `aso_learner.py:13` (`LearnerThread`),
+`aso_aggregator.py:178` (`SimpleAggregator`).
+
+TPU re-architecture (Podracer/Sebulba shape, SURVEY.md §7.1): CPU actor
+workers sample continuously with up to K requests in flight; the learner
+thread owns the TPU mesh and runs one donated-buffer XLA update per train
+batch. Host→device staging happens on the learner thread right before the
+update while the previous update is still executing on device (JAX
+dispatch is async), double-buffering the feed — the replacement for the
+reference's `_LoaderThread` (`aso_multi_gpu_learner.py:140`).
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from typing import List
+
+import ray_tpu
+
+from ..sample_batch import SampleBatch
+from ..utils.actors import TaskPool
+from ..utils.window_stat import WindowStat
+from .policy_optimizer import PolicyOptimizer
+
+logger = logging.getLogger(__name__)
+
+LEARNER_QUEUE_MAX_SIZE = 16
+
+
+class LearnerThread(threading.Thread):
+    """Consumes train batches from inqueue, updates the policy on device.
+
+    Parity: `aso_learner.py:13`. Runs on the trainer process so rollout
+    collection never blocks on the device update.
+    """
+
+    def __init__(self, local_worker, learner_queue_size: int = 16,
+                 num_sgd_iter: int = 1, sgd_minibatch_size: int = 0,
+                 sgd_sequence_length: int = 1):
+        super().__init__(daemon=True, name="learner")
+        self.local_worker = local_worker
+        self.inqueue: "queue.Queue[SampleBatch]" = queue.Queue(
+            maxsize=learner_queue_size)
+        self.outqueue: "queue.Queue" = queue.Queue()
+        self.num_sgd_iter = num_sgd_iter
+        self.sgd_minibatch_size = sgd_minibatch_size
+        self.sgd_sequence_length = sgd_sequence_length
+        self.stopped = False
+        self.weights_updated = False
+        self.stats = {}
+        self.learner_queue_size = WindowStat("learner_queue_size", 50)
+        self.queue_timer = _Timer()
+        self.grad_timer = _Timer()
+        self.daemon = True
+
+    def run(self):
+        while not self.stopped:
+            self.step()
+
+    def step(self):
+        with self.queue_timer:
+            try:
+                batch = self.inqueue.get(timeout=0.5)
+            except queue.Empty:
+                return
+        self.learner_queue_size.push(self.inqueue.qsize())
+        with self.grad_timer:
+            policy = self.local_worker.policy
+            if self.sgd_minibatch_size:
+                # Sequence-granular shuffling keeps V-trace fragments
+                # contiguous inside each minibatch.
+                stats = policy.sgd_learn(
+                    batch, self.num_sgd_iter, self.sgd_minibatch_size,
+                    seq_len=self.sgd_sequence_length)
+            else:
+                for _ in range(self.num_sgd_iter):
+                    stats = policy.learn_on_batch(batch)
+            self.stats = stats
+        self.weights_updated = True
+        self.outqueue.put(batch.count)
+
+    def stop(self):
+        self.stopped = True
+
+
+class AsyncSamplesOptimizer(PolicyOptimizer):
+    """Keep workers sampling continuously; learn as batches arrive."""
+
+    def __init__(self, workers,
+                 train_batch_size: int = 500,
+                 rollout_fragment_length: int = 50,
+                 max_sample_requests_in_flight_per_worker: int = 2,
+                 broadcast_interval: int = 1,
+                 learner_queue_size: int = LEARNER_QUEUE_MAX_SIZE,
+                 num_sgd_iter: int = 1,
+                 sgd_minibatch_size: int = 0,
+                 sgd_sequence_length: int = 1):
+        super().__init__(workers)
+        self.train_batch_size = train_batch_size
+        self.rollout_fragment_length = rollout_fragment_length
+        self.broadcast_interval = broadcast_interval
+        self.max_in_flight = max_sample_requests_in_flight_per_worker
+        self.learner = LearnerThread(
+            workers.local_worker,
+            learner_queue_size=learner_queue_size,
+            num_sgd_iter=num_sgd_iter,
+            sgd_minibatch_size=sgd_minibatch_size,
+            sgd_sequence_length=sgd_sequence_length)
+        self.learner.start()
+
+        self.sample_tasks = TaskPool()
+        self._batch_buffer: List[SampleBatch] = []
+        self._batch_buffer_count = 0
+        self.num_weight_broadcasts = 0
+        self.num_steps_since_broadcast = 0
+        self._broadcasted_weights = None
+        self.learner_stats = {}
+
+        if workers.remote_workers:
+            self._broadcast_weights()
+            for w in workers.remote_workers:
+                for _ in range(self.max_in_flight):
+                    self.sample_tasks.add(w, w.sample.remote())
+
+    # ------------------------------------------------------------------
+    def _broadcast_weights(self):
+        self._broadcasted_weights = ray_tpu.put(
+            self.workers.local_worker.get_weights())
+        self.num_weight_broadcasts += 1
+        self.num_steps_since_broadcast = 0
+
+    def step(self) -> dict:
+        if not self.workers.remote_workers:
+            return self._step_local()
+        sampled = 0
+        trained = 0
+        deadline = time.monotonic() + 60.0
+        while (trained == 0 and time.monotonic() < deadline):
+            sampled += self._pull_and_enqueue()
+            while not self.learner.outqueue.empty():
+                trained += self.learner.outqueue.get()
+            if trained == 0:
+                time.sleep(0.001)
+        self.num_steps_sampled += sampled
+        self.num_steps_trained += trained
+        self.learner_stats = self.learner.stats
+        return self.learner_stats
+
+    def _pull_and_enqueue(self) -> int:
+        """Collect finished sample tasks, refill in-flight requests, build
+        train batches, and feed the learner (parity: SimpleAggregator
+        `iter_train_batches` + optimizer `_step`)."""
+        sampled = 0
+        for worker, ref in self.sample_tasks.completed(blocking_wait=True):
+            batch = ray_tpu.get(ref)
+            sampled += batch.count
+            self._batch_buffer.append(batch)
+            self._batch_buffer_count += batch.count
+            if self._batch_buffer_count >= self.train_batch_size:
+                train_batch = SampleBatch.concat_samples(self._batch_buffer)
+                self._batch_buffer = []
+                self._batch_buffer_count = 0
+                try:
+                    self.learner.inqueue.put(train_batch, timeout=30.0)
+                except queue.Full:
+                    logger.warning("learner queue full; dropping batch")
+            # Refresh weights on the worker if the learner moved on.
+            if self.learner.weights_updated and \
+                    self.num_steps_since_broadcast >= self.broadcast_interval:
+                self.learner.weights_updated = False
+                self._broadcast_weights()
+            self.num_steps_since_broadcast += 1
+            worker.set_weights.remote(self._broadcasted_weights)
+            self.sample_tasks.add(worker, worker.sample.remote())
+        return sampled
+
+    def _step_local(self) -> dict:
+        """Degenerate num_workers=0 mode: sample locally, learn inline."""
+        batches = []
+        count = 0
+        while count < self.train_batch_size:
+            b = self.workers.local_worker.sample()
+            batches.append(b)
+            count += b.count
+        train_batch = SampleBatch.concat_samples(batches)
+        self.learner.inqueue.put(train_batch)
+        # Generous timeout: the first update includes XLA compilation,
+        # which can take minutes for large programs.
+        trained = self.learner.outqueue.get(timeout=600.0)
+        self.num_steps_sampled += count
+        self.num_steps_trained += trained
+        self.learner_stats = self.learner.stats
+        return self.learner_stats
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update({
+            "num_weight_broadcasts": self.num_weight_broadcasts,
+            "learner_queue": self.learner.learner_queue_size.stats(),
+            "timing": {
+                "learner_grad_time_ms": round(
+                    1000 * self.learner.grad_timer.mean, 3),
+                "learner_queue_wait_ms": round(
+                    1000 * self.learner.queue_timer.mean, 3),
+            },
+        })
+        return out
+
+    def stop(self):
+        self.learner.stop()
+        self.learner.join(timeout=5.0)
+
+
+class _Timer:
+    """Tiny context-manager timer (parity: ray.timer.TimerStat)."""
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self._start = None
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.total += time.perf_counter() - self._start
+        self.count += 1
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0

@@ -22,6 +22,7 @@ for XLA:
 from __future__ import annotations
 
 import functools
+import threading
 from typing import Callable, Dict, Optional
 
 import jax
@@ -110,6 +111,10 @@ class JaxPolicy(Policy):
         self._build_jitted_fns()
         self._sgd_fns: Dict = {}
         self.global_timestep = 0
+        # Updates donate self.params; serialize them against weight
+        # reads/writes from other threads (async optimizers run learning
+        # on a LearnerThread while the driver broadcasts weights).
+        self._update_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     def apply(self, params, obs, **kwargs):
@@ -179,8 +184,9 @@ class JaxPolicy(Policy):
     def compute_actions(self, obs_batch, state_batches=None, explore=True,
                         prev_action_batch=None, prev_reward_batch=None):
         obs = jnp.asarray(obs_batch)
-        actions, logp, dist_inputs, value = self._action_fn(
-            self.params, obs, self._next_rng(), explore)
+        with self._update_lock:
+            actions, logp, dist_inputs, value = self._action_fn(
+                self.params, obs, self._next_rng(), explore)
         extra = {
             sb.ACTION_LOGP: np.asarray(logp),
             sb.ACTION_DIST_INPUTS: np.asarray(dist_inputs),
@@ -217,38 +223,56 @@ class JaxPolicy(Policy):
 
     def learn_on_batch(self, batch) -> Dict:
         dev_batch = self._device_batch(batch)
-        self.params, self.opt_state, stats = self._train_fn(
-            self.params, self.opt_state, dev_batch, self._next_rng(),
-            self.loss_state)
+        with self._update_lock:
+            self.params, self.opt_state, stats = self._train_fn(
+                self.params, self.opt_state, dev_batch, self._next_rng(),
+                self.loss_state)
         self.global_timestep += batch.count if hasattr(batch, "count") \
             else len(next(iter(batch.values())))
         return {k: float(v) for k, v in stats.items()}
 
-    def sgd_learn(self, batch, num_sgd_iter: int, minibatch_size: int) -> Dict:
-        """Whole minibatch-SGD phase as one XLA program (see module doc)."""
+    def sgd_learn(self, batch, num_sgd_iter: int, minibatch_size: int,
+                  seq_len: int = 1) -> Dict:
+        """Whole minibatch-SGD phase as one XLA program (see module doc).
+
+        With seq_len > 1 (V-trace/recurrent losses that reshape flat rows
+        into [B, seq_len] fragments), shuffling and minibatch slicing
+        happen at sequence granularity so fragment contiguity survives.
+        """
         n = batch.count
+        if seq_len > 1 and minibatch_size % seq_len:
+            raise ValueError(
+                f"sgd minibatch_size {minibatch_size} must be a multiple "
+                f"of sequence length {seq_len}")
         # Drop the remainder so minibatches tile exactly (same behavior as
         # the reference's tower loader truncation, multi_gpu_impl.py:116).
         num_mb = max(1, n // minibatch_size)
         usable = num_mb * minibatch_size
         dev_batch = self._device_batch(batch.slice(0, usable))
-        key = (num_sgd_iter, num_mb, minibatch_size)
+        key = (num_sgd_iter, num_mb, minibatch_size, seq_len)
         if key not in self._sgd_fns:
             self._sgd_fns[key] = self._make_sgd_fn(*key)
-        self.params, self.opt_state, stats = self._sgd_fns[key](
-            self.params, self.opt_state, dev_batch, self._next_rng(),
-            self.loss_state)
+        with self._update_lock:
+            self.params, self.opt_state, stats = self._sgd_fns[key](
+                self.params, self.opt_state, dev_batch, self._next_rng(),
+                self.loss_state)
         self.global_timestep += n
         return {k: float(v) for k, v in stats.items()}
 
-    def _make_sgd_fn(self, num_sgd_iter: int, num_mb: int, mb_size: int):
+    def _make_sgd_fn(self, num_sgd_iter: int, num_mb: int, mb_size: int,
+                     seq_len: int = 1):
         def sgd_fn(params, opt_state, batch, rng, loss_state):
             usable = num_mb * mb_size
+            num_seq = usable // seq_len
 
             def epoch(carry, erng):
                 params, opt_state = carry
-                perm = jax.random.permutation(erng, usable)
-                shuffled = jax.tree.map(lambda x: x[perm], batch)
+                # Permute whole sequences: rows within a seq_len block stay
+                # contiguous (seq_len=1 degenerates to row shuffling).
+                perm = jax.random.permutation(erng, num_seq)
+                idx = (perm[:, None] * seq_len
+                       + jnp.arange(seq_len)[None, :]).reshape(-1)
+                shuffled = jax.tree.map(lambda x: x[idx], batch)
                 mbs = jax.tree.map(
                     lambda x: x.reshape((num_mb, mb_size) + x.shape[1:]),
                     shuffled)
@@ -289,17 +313,20 @@ class JaxPolicy(Policy):
         return host, {k: float(v) for k, v in stats.items()}
 
     def apply_gradients(self, gradients):
-        self.params, self.opt_state = self._apply_grads_fn(
-            self.params, self.opt_state, gradients)
+        with self._update_lock:
+            self.params, self.opt_state = self._apply_grads_fn(
+                self.params, self.opt_state, gradients)
 
     # ------------------------------------------------------------------
     # weights
     # ------------------------------------------------------------------
     def get_weights(self):
-        return jax.tree.map(np.asarray, self.params)
+        with self._update_lock:
+            return jax.tree.map(np.asarray, self.params)
 
     def set_weights(self, weights):
-        self.params = mesh_lib.put_replicated(weights, self.mesh)
+        with self._update_lock:
+            self.params = mesh_lib.put_replicated(weights, self.mesh)
 
     def get_state(self):
         return {

@@ -1,0 +1,38 @@
+"""In-flight task tracking over actor fleets.
+
+Parity: `rllib/utils/actors.py:8` `TaskPool` — tracks pending
+`sample.remote()` calls so async optimizers can pull completed batches as
+they arrive and keep every worker busy.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, Tuple
+
+import ray_tpu
+
+
+class TaskPool:
+    def __init__(self):
+        self._tasks: Dict = {}   # ObjectRef -> actor handle
+
+    def add(self, worker, obj_ref) -> None:
+        self._tasks[obj_ref] = worker
+
+    def completed(self, blocking_wait: bool = False
+                  ) -> Iterator[Tuple[object, object]]:
+        """Yield (worker, ref) for finished tasks; removes them."""
+        pending = list(self._tasks)
+        if not pending:
+            return
+        ready, _ = ray_tpu.wait(
+            pending, num_returns=len(pending), timeout=0)
+        if not ready and blocking_wait:
+            ready, _ = ray_tpu.wait(pending, num_returns=1, timeout=10.0)
+        for ref in ready:
+            worker = self._tasks.pop(ref)
+            yield worker, ref
+
+    @property
+    def count(self) -> int:
+        return len(self._tasks)

@@ -1,0 +1,31 @@
+"""Sliding-window statistics (parity: `rllib/utils/window_stat.py`)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class WindowStat:
+    def __init__(self, name: str, n: int):
+        self.name = name
+        self.items = [None] * n
+        self.idx = 0
+        self.count = 0
+
+    def push(self, obj) -> None:
+        self.items[self.idx] = obj
+        self.idx = (self.idx + 1) % len(self.items)
+        self.count += 1
+
+    def stats(self) -> dict:
+        window = [x for x in self.items if x is not None]
+        if not window:
+            return {self.name + "_count": 0}
+        return {
+            self.name + "_count": int(self.count),
+            self.name + "_mean": float(np.mean(window)),
+            self.name + "_max": float(np.max(window)),
+            self.name + "_quantiles": [
+                round(float(q), 4)
+                for q in np.percentile(window, [0, 10, 50, 90, 100])],
+        }
