@@ -94,6 +94,51 @@ class VisionNetwork(nn.Module):
         return logits, value[..., 0]
 
 
+class QNetwork(nn.Module):
+    """Q-value network for DQN-family policies.
+
+    Parity: `rllib/agents/dqn/dqn_policy.py` QValuePolicy graphs (dueling /
+    noisy options; we implement dueling). Returns `(q_values, max_q)` so it
+    plugs into the standard `(dist_inputs, value)` policy interface —
+    dist_inputs ARE the q-values and the greedy value doubles as the
+    state-value estimate.
+
+    3-D observations get a bfloat16 Nature-CNN trunk (MXU-native); flat
+    observations get an MLP trunk.
+    """
+
+    num_actions: int
+    hiddens: Sequence[int] = (256,)
+    activation: str = "relu"
+    dueling: bool = True
+    conv_filters: Sequence[Tuple[int, int, int]] = (
+        (32, 8, 4), (64, 4, 2), (64, 3, 1))
+    compute_dtype: Dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, obs):
+        act = _activation(self.activation)
+        if obs.ndim == 4:  # [B, H, W, C] image frames
+            x = obs.astype(self.compute_dtype) / jnp.asarray(
+                255.0, self.compute_dtype)
+            for i, (ch, k, s) in enumerate(self.conv_filters):
+                x = act(nn.Conv(ch, (k, k), strides=(s, s), padding="VALID",
+                                dtype=self.compute_dtype,
+                                name=f"conv_{i}")(x))
+            h = x.reshape(x.shape[0], -1).astype(jnp.float32)
+        else:
+            h = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+        for i, size in enumerate(self.hiddens):
+            h = act(nn.Dense(size, name=f"fc_{i}")(h))
+        if self.dueling:
+            adv = nn.Dense(self.num_actions, name="advantage")(h)
+            value = nn.Dense(1, name="state_value")(h)
+            q = value + adv - jnp.mean(adv, axis=-1, keepdims=True)
+        else:
+            q = nn.Dense(self.num_actions, name="q")(h)
+        return q, jnp.max(q, axis=-1)
+
+
 class LSTMNetwork(nn.Module):
     """Feature trunk + LSTM core (parity: `lstm_v1.py` use_lstm wrapping).
 

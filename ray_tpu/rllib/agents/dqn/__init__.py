@@ -1,0 +1,6 @@
+from .apex import APEX_DEFAULT_CONFIG, ApexTrainer
+from .dqn import DEFAULT_CONFIG, SIMPLE_Q_CONFIG, DQNTrainer, SimpleQTrainer
+from .dqn_policy import DQNPolicy
+
+__all__ = ["APEX_DEFAULT_CONFIG", "ApexTrainer", "DEFAULT_CONFIG",
+           "DQNPolicy", "DQNTrainer", "SIMPLE_Q_CONFIG", "SimpleQTrainer"]

@@ -1,0 +1,85 @@
+"""Ape-X DQN: distributed prioritized experience replay.
+
+Parity: `rllib/agents/dqn/apex.py` — DQN policy + AsyncReplayOptimizer
+with sharded replay actors, per-worker constant exploration epsilons
+(eps_i = 0.4^(1 + 7*i/(N-1)), Horgan et al.), worker-side initial
+priorities, and periodic target-network sync by timestep.
+"""
+
+from __future__ import annotations
+
+from ...optimizers.async_replay_optimizer import AsyncReplayOptimizer
+from ..trainer import deep_merge
+from ..trainer_template import build_trainer
+from .dqn import DEFAULT_CONFIG as DQN_CONFIG
+from .dqn_policy import DQNPolicy
+
+APEX_DEFAULT_CONFIG = deep_merge(deep_merge({}, DQN_CONFIG), {
+    "optimizer": {
+        "max_weight_sync_delay": 400,
+        "num_replay_buffer_shards": 4,
+        "debug": False,
+    },
+    "n_step": 3,
+    "num_workers": 32,
+    "buffer_size": 2000000,
+    "learning_starts": 50000,
+    "train_batch_size": 512,
+    "rollout_fragment_length": 50,
+    "target_network_update_freq": 500000,
+    "timesteps_per_iteration": 25000,
+    "worker_side_prioritization": True,
+    "min_iter_time_s": 30,
+    # Per-worker constant epsilons instead of one annealed schedule.
+    "per_worker_exploration": True,
+})
+
+
+def make_async_replay_optimizer(workers, config):
+    return AsyncReplayOptimizer(
+        workers,
+        learning_starts=config["learning_starts"],
+        buffer_size=config["buffer_size"],
+        train_batch_size=config["train_batch_size"],
+        rollout_fragment_length=config["rollout_fragment_length"],
+        num_replay_buffer_shards=config["optimizer"][
+            "num_replay_buffer_shards"],
+        max_weight_sync_delay=config["optimizer"]["max_weight_sync_delay"],
+        prioritized_replay_alpha=config["prioritized_replay_alpha"],
+        prioritized_replay_beta=config["prioritized_replay_beta"],
+        prioritized_replay_eps=config["prioritized_replay_eps"])
+
+
+def setup_apex_exploration(trainer):
+    """eps_i = 0.4^(1 + 7*i/(N-1)) per Ape-X (reference:
+    `dqn_policy.py` exploration setup under per_worker_exploration)."""
+    trainer._last_target_update_ts = 0
+    trainer._num_target_updates = 0
+    workers = trainer.workers.remote_workers
+    n = max(1, len(workers))
+    trainer.get_policy().set_epsilon(0.0)  # learner-side greedy
+    for i, w in enumerate(workers):
+        exponent = 1.0 + (i / max(1, n - 1)) * 7.0
+        w.apply.remote(_set_eps, 0.4 ** exponent)
+
+
+def _set_eps(worker, eps):
+    worker.policy.set_epsilon(eps)
+
+
+def apex_update_target(trainer, fetches):
+    ts = trainer.optimizer.num_steps_trained
+    if ts - trainer._last_target_update_ts >= \
+            trainer.config["target_network_update_freq"]:
+        trainer.get_policy().update_target()
+        trainer._last_target_update_ts = ts
+        trainer._num_target_updates += 1
+
+
+ApexTrainer = build_trainer(
+    name="APEX",
+    default_policy=DQNPolicy,
+    default_config=APEX_DEFAULT_CONFIG,
+    make_policy_optimizer=make_async_replay_optimizer,
+    after_init=setup_apex_exploration,
+    after_optimizer_step=apex_update_target)

@@ -26,12 +26,30 @@ def _a2c():
     return A2CTrainer
 
 
+def _dqn():
+    from .dqn import DQNTrainer
+    return DQNTrainer
+
+
+def _simple_q():
+    from .dqn import SimpleQTrainer
+    return SimpleQTrainer
+
+
+def _apex():
+    from .dqn import ApexTrainer
+    return ApexTrainer
+
+
 ALGORITHMS = {
     "PG": _pg,
     "PPO": _ppo,
     "IMPALA": _impala,
     "A3C": _a3c,
     "A2C": _a2c,
+    "DQN": _dqn,
+    "SimpleQ": _simple_q,
+    "APEX": _apex,
 }
 
 

@@ -61,12 +61,15 @@ def build_trainer(name: str,
             min_steps = self.config.get("timesteps_per_iteration") or 0
             while True:
                 fetches = self.optimizer.step()
+                # Per-step hook (reference runs it inside the pacing
+                # loop, trainer_template.py:125 — e.g. DQN target-network
+                # sync must fire mid-iteration).
+                if after_optimizer_step:
+                    after_optimizer_step(self, fetches)
                 if (time.monotonic() - start >= min_time
                         and self.optimizer.num_steps_sampled - steps0
                         >= min_steps):
                     break
-            if after_optimizer_step:
-                after_optimizer_step(self, fetches)
             result = self._result_from_optimizer(self.optimizer)
             if after_train_result:
                 after_train_result(self, result)

@@ -129,6 +129,17 @@ class RolloutWorker:
     def sync_filters(self, new_filter):
         self.obs_filter.sync(new_filter)
 
+    def apply(self, fn, *args):
+        """Run fn(self, *args) — generic hook used by trainers to reach
+        into remote workers (parity: `rollout_worker.py apply`)."""
+        return fn(self, *args)
+
+    def foreach_policy(self, fn):
+        """fn(policy, policy_id) over all policies (single-policy worker:
+        one entry; reference signature, `rollout_worker.py
+        foreach_policy`)."""
+        return [fn(self.policy, "default_policy")]
+
     # -- metrics / introspection -----------------------------------------
     def get_metrics(self) -> List:
         return self.sampler.get_metrics()

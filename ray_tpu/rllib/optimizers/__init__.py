@@ -1,2 +1,7 @@
+from .async_gradients_optimizer import AsyncGradientsOptimizer  # noqa: F401
+from .async_replay_optimizer import AsyncReplayOptimizer, ReplayActor  # noqa: F401
+from .async_samples_optimizer import AsyncSamplesOptimizer  # noqa: F401
 from .policy_optimizer import PolicyOptimizer  # noqa: F401
+from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer  # noqa: F401
+from .sync_replay_optimizer import SyncReplayOptimizer  # noqa: F401
 from .sync_samples_optimizer import MultiDeviceOptimizer, SyncSamplesOptimizer  # noqa: F401

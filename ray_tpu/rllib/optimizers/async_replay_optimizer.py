@@ -1,0 +1,282 @@
+"""Ape-X style async replay optimizer.
+
+Parity: `rllib/optimizers/async_replay_optimizer.py:31`
+(`AsyncReplayOptimizer`), `ReplayActor` (:255, sharded prioritized replay,
+`update_priorities`:318).
+
+Architecture (same actor topology as the reference, TPU learner):
+
+  rollout workers --sample--> replay shard actors (prioritized buffers)
+  replay shards --replay batches--> learner thread (owns the TPU mesh)
+  learner --|td| priorities--> replay shards;  weights --> workers
+
+Sample batches flow worker→shard as ObjectRefs, so the payload moves
+through the object store without a driver copy. The learner thread stages
+the next replay batch host→device while the previous update runs (JAX
+async dispatch), replacing the reference's `_LoaderThread`.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import random
+import threading
+import time
+from typing import List, Optional
+
+import numpy as np
+
+import ray_tpu
+
+from ..sample_batch import SampleBatch
+from ..utils.actors import TaskPool
+from ..utils.window_stat import WindowStat
+from .policy_optimizer import PolicyOptimizer
+from .replay_buffer import PrioritizedReplayBuffer
+
+logger = logging.getLogger(__name__)
+
+
+class ReplayActor:
+    """One shard of the distributed prioritized replay memory.
+
+    Parity: `async_replay_optimizer.py:255` — buffer sizes and warmup
+    thresholds are divided by the shard count by the optimizer.
+    """
+
+    def __init__(self, learning_starts: int, buffer_size: int,
+                 train_batch_size: int,
+                 prioritized_replay_alpha: float = 0.6,
+                 prioritized_replay_beta: float = 0.4,
+                 prioritized_replay_eps: float = 1e-6):
+        self.learning_starts = learning_starts
+        self.train_batch_size = train_batch_size
+        self.prioritized_replay_beta = prioritized_replay_beta
+        self.prioritized_replay_eps = prioritized_replay_eps
+        self.buffer = PrioritizedReplayBuffer(
+            buffer_size, alpha=prioritized_replay_alpha)
+
+    def add_batch(self, batch: SampleBatch) -> int:
+        self.buffer.add_batch(batch)
+        if "td_error" in batch:
+            # Worker-side initial priorities (dqn_policy.py postprocess).
+            n = batch.count
+            start = (self.buffer._next_idx - n) % self.buffer.capacity
+            idxs = (start + np.arange(n)) % self.buffer.capacity
+            self.buffer.update_priorities(
+                idxs, np.abs(np.asarray(batch["td_error"]))
+                + self.prioritized_replay_eps)
+        return batch.count
+
+    def replay(self) -> Optional[SampleBatch]:
+        """A train batch with `batch_indexes` + IS `weights` columns, or
+        None while warming up."""
+        if len(self.buffer) < self.learning_starts:
+            return None
+        batch, _ = self.buffer.sample(
+            self.train_batch_size, beta=self.prioritized_replay_beta)
+        return batch
+
+    def update_priorities(self, batch_indexes, td_errors) -> None:
+        self.buffer.update_priorities(
+            batch_indexes,
+            np.abs(np.asarray(td_errors)) + self.prioritized_replay_eps)
+
+    def stats(self) -> dict:
+        return self.buffer.stats()
+
+    def ping(self):
+        return "ok"
+
+
+class _ReplayLearnerThread(threading.Thread):
+    """Consumes replay batches, updates the policy, emits priority
+    refreshes (parity: `aso_learner.py:13` specialized for replay)."""
+
+    def __init__(self, local_worker):
+        super().__init__(daemon=True, name="apex-learner")
+        self.local_worker = local_worker
+        self.inqueue: "queue.Queue" = queue.Queue(maxsize=8)
+        self.outqueue: "queue.Queue" = queue.Queue()
+        self.stopped = False
+        self.stats = {}
+        self.weights_updated = False
+        self.queue_size_stat = WindowStat("learner_queue", 50)
+
+    def run(self):
+        while not self.stopped:
+            try:
+                replay_actor, batch = self.inqueue.get(timeout=0.5)
+            except queue.Empty:
+                continue
+            self.queue_size_stat.push(self.inqueue.qsize())
+            try:
+                stats, td_abs = self.local_worker.policy.learn_with_td(
+                    batch)
+            except Exception:
+                # A dead learner thread silently halts training while
+                # sampling continues — log loudly and keep consuming.
+                logger.exception("apex learner update failed; continuing")
+                continue
+            self.stats = stats
+            self.weights_updated = True
+            self.outqueue.put(
+                (replay_actor, batch["batch_indexes"], td_abs, batch.count))
+
+    def stop(self):
+        self.stopped = True
+
+
+class AsyncReplayOptimizer(PolicyOptimizer):
+    def __init__(self, workers,
+                 learning_starts: int = 1000,
+                 buffer_size: int = 10000,
+                 train_batch_size: int = 512,
+                 rollout_fragment_length: int = 50,
+                 num_replay_buffer_shards: int = 1,
+                 max_weight_sync_delay: int = 400,
+                 prioritized_replay_alpha: float = 0.6,
+                 prioritized_replay_beta: float = 0.4,
+                 prioritized_replay_eps: float = 1e-6,
+                 debug: bool = False):
+        super().__init__(workers)
+        self.learning_starts = learning_starts
+        self.max_weight_sync_delay = max_weight_sync_delay
+        self.learner = _ReplayLearnerThread(workers.local_worker)
+        self.learner.start()
+
+        RemoteReplayActor = ray_tpu.remote(ReplayActor)
+        self.replay_actors = [
+            RemoteReplayActor.options(num_cpus=0.1).remote(
+                max(1, learning_starts // num_replay_buffer_shards),
+                max(1, buffer_size // num_replay_buffer_shards),
+                train_batch_size,
+                prioritized_replay_alpha,
+                prioritized_replay_beta,
+                prioritized_replay_eps)
+            for _ in range(num_replay_buffer_shards)]
+        ray_tpu.get([ra.ping.remote() for ra in self.replay_actors])
+
+        # Worker → shard sample flow.
+        self._sample_tasks = TaskPool()     # add_batch results
+        self._replay_tasks = TaskPool()     # replay() results
+        self._sample_refs = {}              # worker -> in-flight count
+        self.steps_since_update = {}        # worker -> steps since weights
+        self.num_weight_syncs = 0
+        self.num_samples_dropped = 0
+        self.learner_stats = {}
+
+        if self.workers.remote_workers:
+            self._set_workers(self.workers.remote_workers)
+        for ra in self.replay_actors:
+            self._replay_tasks.add(ra, ra.replay.remote())
+
+    # ------------------------------------------------------------------
+    def _set_workers(self, remote_workers):
+        weights = ray_tpu.put(self.workers.local_worker.get_weights())
+        for w in remote_workers:
+            self.steps_since_update[w] = 0
+            w.set_weights.remote(weights)
+            self._launch_sample(w)
+
+    def _launch_sample(self, worker):
+        ref = worker.sample.remote()
+        ra = random.choice(self.replay_actors)
+        # Hand the sample ObjectRef straight to the shard: the batch moves
+        # worker→shard through the object store, never through the driver.
+        count_ref = ra.add_batch.remote(ref)
+        self._sample_tasks.add(worker, count_ref)
+
+    # ------------------------------------------------------------------
+    def step(self) -> dict:
+        if not self.workers.remote_workers:
+            return self._step_local()
+        start = time.monotonic()
+        sampled, trained = 0, 0
+        while trained == 0 and time.monotonic() - start < 120.0:
+            sampled += self._process_samples()
+            self._process_replays()
+            trained += self._process_learner_out()
+            if trained == 0:
+                time.sleep(0.001)
+        self.num_steps_sampled += sampled
+        self.num_steps_trained += trained
+        self.learner_stats = self.learner.stats
+        return self.learner_stats
+
+    def _process_samples(self) -> int:
+        sampled = 0
+        weights_ref = None
+        for worker, count_ref in self._sample_tasks.completed():
+            count = ray_tpu.get(count_ref)
+            sampled += count
+            # steps_since_update counts env steps (reference semantics:
+            # async_replay_optimizer.py `max_weight_sync_delay`).
+            self.steps_since_update[worker] += count
+            if self.steps_since_update[worker] >= \
+                    self.max_weight_sync_delay:
+                if weights_ref is None:
+                    weights_ref = ray_tpu.put(
+                        self.workers.local_worker.get_weights())
+                worker.set_weights.remote(weights_ref)
+                self.num_weight_syncs += 1
+                self.steps_since_update[worker] = 0
+            self._launch_sample(worker)
+        return sampled
+
+    def _process_replays(self):
+        for ra, ref in self._replay_tasks.completed():
+            batch = ray_tpu.get(ref)
+            if batch is not None:
+                try:
+                    self.learner.inqueue.put((ra, batch), timeout=0.05)
+                except queue.Full:
+                    self.num_samples_dropped += batch.count
+            self._replay_tasks.add(ra, ra.replay.remote())
+
+    def _process_learner_out(self) -> int:
+        trained = 0
+        while not self.learner.outqueue.empty():
+            ra, idxes, td_abs, count = self.learner.outqueue.get()
+            ra.update_priorities.remote(idxes, td_abs)
+            trained += count
+        return trained
+
+    def _step_local(self) -> dict:
+        """num_workers=0: sample locally into shard 0, learn inline."""
+        w = self.workers.local_worker
+        batch = w.sample()
+        self.num_steps_sampled += batch.count
+        ra = self.replay_actors[0]
+        ray_tpu.get(ra.add_batch.remote(batch))
+        replay = ray_tpu.get(ra.replay.remote())
+        if replay is not None:
+            stats, td_abs = w.policy.learn_with_td(replay)
+            ra.update_priorities.remote(replay["batch_indexes"], td_abs)
+            self.num_steps_trained += replay.count
+            self.learner_stats = stats
+        return self.learner_stats
+
+    # ------------------------------------------------------------------
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update({
+            "num_weight_syncs": self.num_weight_syncs,
+            "num_samples_dropped": self.num_samples_dropped,
+            "learner_queue": self.learner.queue_size_stat.stats(),
+        })
+        replay_stats = ray_tpu.get(
+            [ra.stats.remote() for ra in self.replay_actors[:1]])
+        if replay_stats:
+            out["replay_shard_0"] = replay_stats[0]
+        return out
+
+    def stop(self):
+        self.learner.stop()
+        self.learner.join(timeout=5.0)
+        for ra in self.replay_actors:
+            try:
+                ray_tpu.kill(ra)
+            except Exception:
+                pass

@@ -24,10 +24,15 @@ class PolicyOptimizer:
         }
 
     def save(self):
-        return []
+        """Persist progress counters so resumed runs keep schedules
+        (epsilon/beta annealing, learning_starts gating) in place."""
+        return {"num_steps_trained": self.num_steps_trained,
+                "num_steps_sampled": self.num_steps_sampled}
 
     def restore(self, data):
-        pass
+        if isinstance(data, dict):
+            self.num_steps_trained = data.get("num_steps_trained", 0)
+            self.num_steps_sampled = data.get("num_steps_sampled", 0)
 
     def stop(self):
         pass

@@ -1,0 +1,85 @@
+"""Array-backed segment trees for prioritized replay.
+
+Parity: `rllib/optimizers/segment_tree.py` (SumSegmentTree, MinSegmentTree)
+— re-designed host-vectorized: all updates and prefix-sum queries operate on
+whole index *batches* with numpy (one O(log n) vectorized sweep per level),
+because the TPU-side learner consumes minibatches, so the host never needs
+per-item tree ops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class SegmentTree:
+    """Complete binary tree over `capacity` slots stored in one flat array.
+
+    Leaves live at [size, 2*size); internal node i aggregates children
+    2i and 2i+1 under `operation` (np ufunc with .reduce semantics).
+    """
+
+    def __init__(self, capacity: int, operation, neutral: float):
+        size = 1
+        while size < capacity:
+            size *= 2
+        self._size = size
+        self.capacity = capacity
+        self._op = operation
+        self._neutral = neutral
+        self._tree = np.full(2 * size, neutral, dtype=np.float64)
+
+    # -- updates ---------------------------------------------------------
+    def set_items(self, idxs, values) -> None:
+        """Set leaves at `idxs` (vectorized) and repair ancestors."""
+        idxs = np.asarray(idxs, dtype=np.int64) + self._size
+        self._tree[idxs] = np.asarray(values, dtype=np.float64)
+        parents = np.unique(idxs // 2)
+        while parents.size and parents[0] >= 1:
+            self._tree[parents] = self._op(
+                self._tree[2 * parents], self._tree[2 * parents + 1])
+            parents = np.unique(parents // 2)
+            if parents[0] == 0:
+                break
+
+    def __setitem__(self, idx, val):
+        self.set_items(np.atleast_1d(idx), np.atleast_1d(val))
+
+    def __getitem__(self, idx):
+        return self._tree[self._size + idx]
+
+    def get_items(self, idxs):
+        return self._tree[self._size + np.asarray(idxs, dtype=np.int64)]
+
+    def reduce_all(self) -> float:
+        return float(self._tree[1])
+
+
+class SumSegmentTree(SegmentTree):
+    def __init__(self, capacity: int):
+        super().__init__(capacity, np.add, 0.0)
+
+    def sum(self) -> float:
+        return self.reduce_all()
+
+    def find_prefixsum_idx(self, prefixsums) -> np.ndarray:
+        """Vectorized: for each p, the smallest leaf i with
+        cumsum(leaves[0..i]) > p. Descends all queries one level at a
+        time (log n numpy steps total, independent of batch size)."""
+        p = np.asarray(prefixsums, dtype=np.float64).copy()
+        idx = np.ones(len(p), dtype=np.int64)
+        while idx[0] < self._size:  # all idx are at the same level
+            left = 2 * idx
+            left_sum = self._tree[left]
+            go_right = p > left_sum
+            p = np.where(go_right, p - left_sum, p)
+            idx = np.where(go_right, left + 1, left)
+        return np.minimum(idx - self._size, self.capacity - 1)
+
+
+class MinSegmentTree(SegmentTree):
+    def __init__(self, capacity: int):
+        super().__init__(capacity, np.minimum, float("inf"))
+
+    def min(self) -> float:
+        return self.reduce_all()

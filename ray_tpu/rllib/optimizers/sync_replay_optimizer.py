@@ -1,0 +1,93 @@
+"""Synchronous replay optimizer (DQN-style).
+
+Parity: `rllib/optimizers/sync_replay_optimizer.py` — each `step()` collects
+one round of rollouts into the (optionally prioritized) replay buffer, then
+performs one minibatch update sampled from the buffer, feeding TD errors
+back as new priorities.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import ray_tpu
+
+from ..sample_batch import SampleBatch
+from ..utils.schedules import LinearSchedule
+from .policy_optimizer import PolicyOptimizer
+from .replay_buffer import PrioritizedReplayBuffer, ReplayBuffer
+
+
+class SyncReplayOptimizer(PolicyOptimizer):
+    def __init__(self, workers,
+                 learning_starts: int = 1000,
+                 buffer_size: int = 10000,
+                 prioritized_replay: bool = True,
+                 prioritized_replay_alpha: float = 0.6,
+                 prioritized_replay_beta: float = 0.4,
+                 final_prioritized_replay_beta: float = 0.4,
+                 prioritized_replay_beta_annealing_timesteps: int = 100000,
+                 prioritized_replay_eps: float = 1e-6,
+                 train_batch_size: int = 32,
+                 before_learn_on_batch=None):
+        super().__init__(workers)
+        self.learning_starts = learning_starts
+        self.prioritized_replay_eps = prioritized_replay_eps
+        self.train_batch_size = train_batch_size
+        self.before_learn_on_batch = before_learn_on_batch
+        self.prioritized = prioritized_replay
+        if prioritized_replay:
+            self.replay_buffer = PrioritizedReplayBuffer(
+                buffer_size, alpha=prioritized_replay_alpha)
+            self.beta_schedule = LinearSchedule(
+                prioritized_replay_beta_annealing_timesteps,
+                initial_p=prioritized_replay_beta,
+                final_p=final_prioritized_replay_beta)
+        else:
+            self.replay_buffer = ReplayBuffer(buffer_size)
+            self.beta_schedule = None
+        self.learner_stats = {}
+
+    # ------------------------------------------------------------------
+    def step(self) -> dict:
+        # 1. Sample new experience from the rollout workers.
+        if self.workers.remote_workers:
+            self.workers.sync_weights()
+            batches = ray_tpu.get(
+                [w.sample.remote() for w in self.workers.remote_workers])
+            batch = SampleBatch.concat_samples(batches)
+        else:
+            batch = self.workers.local_worker.sample()
+        self.num_steps_sampled += batch.count
+        self.replay_buffer.add_batch(batch)
+
+        # 2. Learn from replay once warm. The buffer-fill check also
+        # covers restored runs (counters persist, buffer contents don't).
+        if self.num_steps_sampled >= self.learning_starts and \
+                len(self.replay_buffer) >= self.train_batch_size:
+            self.learner_stats = self._optimize()
+        return self.learner_stats
+
+    def _optimize(self) -> dict:
+        policy = self.workers.local_worker.policy
+        if self.prioritized:
+            beta = self.beta_schedule.value(self.num_steps_sampled)
+            replay, idxes = self.replay_buffer.sample(
+                self.train_batch_size, beta=beta)
+            if self.before_learn_on_batch:
+                replay = self.before_learn_on_batch(replay, policy)
+            stats, td_abs = policy.learn_with_td(replay)
+            self.replay_buffer.update_priorities(
+                idxes, td_abs + self.prioritized_replay_eps)
+        else:
+            replay = self.replay_buffer.sample(self.train_batch_size)
+            if self.before_learn_on_batch:
+                replay = self.before_learn_on_batch(replay, policy)
+            stats = policy.learn_on_batch(replay)
+        self.num_steps_trained += replay.count
+        return stats
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out["replay_buffer"] = self.replay_buffer.stats()
+        return out

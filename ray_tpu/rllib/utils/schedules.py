@@ -1,0 +1,77 @@
+"""Parameter schedules (exploration epsilon, LR, entropy, beta annealing).
+
+Parity: `rllib/utils/schedules.py` (ConstantSchedule, LinearSchedule,
+PiecewiseSchedule, ExponentialSchedule) — host-side scalar schedules driven
+by the global timestep counter.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+
+class Schedule:
+    def value(self, t: float) -> float:
+        raise NotImplementedError
+
+    def __call__(self, t: float) -> float:
+        return self.value(t)
+
+
+class ConstantSchedule(Schedule):
+    def __init__(self, value: float):
+        self._v = value
+
+    def value(self, t: float) -> float:
+        return self._v
+
+
+class LinearSchedule(Schedule):
+    """Linear interpolation from `initial_p` to `final_p` over
+    `schedule_timesteps`, then constant at `final_p`."""
+
+    def __init__(self, schedule_timesteps: int, final_p: float,
+                 initial_p: float = 1.0):
+        self.schedule_timesteps = schedule_timesteps
+        self.final_p = final_p
+        self.initial_p = initial_p
+
+    def value(self, t: float) -> float:
+        frac = min(float(t) / max(1, self.schedule_timesteps), 1.0)
+        return self.initial_p + frac * (self.final_p - self.initial_p)
+
+
+class PiecewiseSchedule(Schedule):
+    """Linear interpolation between (t, value) endpoints."""
+
+    def __init__(self, endpoints: Sequence[Tuple[float, float]],
+                 outside_value: float = None):
+        idxes = [e[0] for e in endpoints]
+        if idxes != sorted(idxes):
+            raise ValueError("endpoints must be sorted by t")
+        self._endpoints: List[Tuple[float, float]] = list(endpoints)
+        self._outside_value = outside_value
+
+    def value(self, t: float) -> float:
+        for (l_t, l_v), (r_t, r_v) in zip(self._endpoints[:-1],
+                                          self._endpoints[1:]):
+            if l_t <= t < r_t:
+                alpha = (t - l_t) / (r_t - l_t)
+                return l_v + alpha * (r_v - l_v)
+        if self._outside_value is not None:
+            return self._outside_value
+        if t < self._endpoints[0][0]:
+            return self._endpoints[0][1]
+        return self._endpoints[-1][1]
+
+
+class ExponentialSchedule(Schedule):
+    def __init__(self, initial_p: float, decay_rate: float,
+                 schedule_timesteps: int):
+        self.initial_p = initial_p
+        self.decay_rate = decay_rate
+        self.schedule_timesteps = schedule_timesteps
+
+    def value(self, t: float) -> float:
+        return self.initial_p * (
+            self.decay_rate ** (float(t) / self.schedule_timesteps))
