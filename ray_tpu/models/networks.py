@@ -139,6 +139,74 @@ class QNetwork(nn.Module):
         return q, jnp.max(q, axis=-1)
 
 
+class DeterministicActor(nn.Module):
+    """mu(s) -> action in [low, high] (DDPG/TD3 actors).
+
+    Parity: `rllib/agents/ddpg/ddpg_policy.py` policy network (tanh
+    squash to the action bounds).
+    """
+
+    action_dim: int
+    low: float = -1.0
+    high: float = 1.0
+    hiddens: Sequence[int] = (256, 256)
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, obs):
+        act = _activation(self.activation)
+        h = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+        for i, size in enumerate(self.hiddens):
+            h = act(nn.Dense(size, name=f"fc_{i}")(h))
+        raw = nn.Dense(self.action_dim, name="out",
+                       kernel_init=nn.initializers.uniform(3e-3))(h)
+        return self.low + (jnp.tanh(raw) + 1.0) \
+            * (self.high - self.low) / 2.0
+
+
+class StochasticActor(nn.Module):
+    """pi(s) -> (mean, log_std) inputs for a SquashedGaussian (SAC)."""
+
+    action_dim: int
+    hiddens: Sequence[int] = (256, 256)
+    activation: str = "relu"
+
+    @nn.compact
+    def __call__(self, obs):
+        act = _activation(self.activation)
+        h = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+        for i, size in enumerate(self.hiddens):
+            h = act(nn.Dense(size, name=f"fc_{i}")(h))
+        return nn.Dense(2 * self.action_dim, name="out")(h)
+
+
+class ContinuousQNetwork(nn.Module):
+    """Q(s, a) -> scalar (DDPG/TD3/SAC critics); `twin` builds two
+    independent towers and returns (q1, q2) (TD3/SAC clipped double-Q)."""
+
+    hiddens: Sequence[int] = (256, 256)
+    activation: str = "relu"
+    twin: bool = False
+
+    @nn.compact
+    def __call__(self, obs, action):
+        act = _activation(self.activation)
+        x = jnp.concatenate(
+            [obs.reshape(obs.shape[0], -1).astype(jnp.float32),
+             action.astype(jnp.float32)], axis=-1)
+
+        def tower(name):
+            h = x
+            for i, size in enumerate(self.hiddens):
+                h = act(nn.Dense(size, name=f"{name}_fc_{i}")(h))
+            return nn.Dense(1, name=f"{name}_out")(h)[..., 0]
+
+        q1 = tower("q1")
+        if self.twin:
+            return q1, tower("q2")
+        return q1, q1
+
+
 class LSTMNetwork(nn.Module):
     """Feature trunk + LSTM core (parity: `lstm_v1.py` use_lstm wrapping).
 

@@ -1,0 +1,8 @@
+from .ddpg import (APEX_DDPG_DEFAULT_CONFIG, DEFAULT_CONFIG,
+                   TD3_DEFAULT_CONFIG, ApexDDPGTrainer, DDPGTrainer,
+                   TD3Trainer)
+from .ddpg_policy import DDPGPolicy
+
+__all__ = ["APEX_DDPG_DEFAULT_CONFIG", "ApexDDPGTrainer", "DDPGPolicy",
+           "DDPGTrainer", "DEFAULT_CONFIG", "TD3_DEFAULT_CONFIG",
+           "TD3Trainer"]

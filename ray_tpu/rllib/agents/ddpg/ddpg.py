@@ -1,0 +1,102 @@
+"""DDPG / TD3 / APEX-DDPG trainers.
+
+Parity: `rllib/agents/ddpg/ddpg.py`, `td3.py`, `apex.py` — replay-based
+continuous control; TD3 = DDPG + twin-Q + delayed smoothed target policy.
+"""
+
+from __future__ import annotations
+
+from ...optimizers.sync_replay_optimizer import SyncReplayOptimizer
+from ..dqn.apex import make_async_replay_optimizer
+from ..dqn.dqn import make_sync_replay_optimizer
+from ..trainer import deep_merge, with_common_config
+from ..trainer_template import build_trainer
+from .ddpg_policy import DDPGPolicy
+
+DEFAULT_CONFIG = with_common_config({
+    "twin_q": False,
+    "policy_delay": 1,
+    "smooth_target_policy": False,
+    "target_noise": 0.2,
+    "target_noise_clip": 0.5,
+    "actor_hiddens": [400, 300],
+    "actor_hidden_activation": "relu",
+    "critic_hiddens": [400, 300],
+    "critic_hidden_activation": "relu",
+    "n_step": 1,
+    "actor_lr": 1e-4,
+    "critic_lr": 1e-3,
+    "tau": 0.002,
+    "l2_reg": 1e-6,
+    "use_huber": False,
+    "huber_threshold": 1.0,
+    "exploration_noise_sigma": 0.1,
+    "exploration_ou": True,   # reference default: OU process
+    "ou_theta": 0.15,
+    "ou_sigma": 0.2,
+    "pure_exploration_steps": 1000,
+    "buffer_size": 50000,
+    "prioritized_replay": True,
+    "prioritized_replay_alpha": 0.6,
+    "prioritized_replay_beta": 0.4,
+    "final_prioritized_replay_beta": 0.4,
+    "prioritized_replay_beta_annealing_timesteps": 20000,
+    "prioritized_replay_eps": 1e-6,
+    "learning_starts": 1500,
+    "rollout_fragment_length": 1,
+    "train_batch_size": 256,
+    "timesteps_per_iteration": 1000,
+    "use_gae": False,
+    "worker_side_prioritization": False,
+})
+
+TD3_DEFAULT_CONFIG = deep_merge(deep_merge({}, DEFAULT_CONFIG), {
+    # TD3 (Fujimoto et al. 2018; reference agents/ddpg/td3.py).
+    "twin_q": True,
+    "policy_delay": 2,
+    "smooth_target_policy": True,
+    "exploration_ou": False,
+    "exploration_noise_sigma": 0.1,
+    "actor_lr": 1e-3,
+    "critic_lr": 1e-3,
+    "tau": 0.005,
+    "l2_reg": 0.0,
+    "prioritized_replay": False,
+    "buffer_size": 100000,
+    "train_batch_size": 100,
+})
+
+APEX_DDPG_DEFAULT_CONFIG = deep_merge(deep_merge({}, DEFAULT_CONFIG), {
+    "optimizer": {
+        "max_weight_sync_delay": 400,
+        "num_replay_buffer_shards": 4,
+    },
+    "n_step": 3,
+    "num_workers": 32,
+    "buffer_size": 2000000,
+    "learning_starts": 50000,
+    "train_batch_size": 512,
+    "rollout_fragment_length": 50,
+    "timesteps_per_iteration": 25000,
+    "worker_side_prioritization": True,
+    "min_iter_time_s": 30,
+})
+
+
+DDPGTrainer = build_trainer(
+    name="DDPG",
+    default_policy=DDPGPolicy,
+    default_config=DEFAULT_CONFIG,
+    make_policy_optimizer=make_sync_replay_optimizer)
+
+TD3Trainer = build_trainer(
+    name="TD3",
+    default_policy=DDPGPolicy,
+    default_config=TD3_DEFAULT_CONFIG,
+    make_policy_optimizer=make_sync_replay_optimizer)
+
+ApexDDPGTrainer = build_trainer(
+    name="APEX_DDPG",
+    default_policy=DDPGPolicy,
+    default_config=APEX_DDPG_DEFAULT_CONFIG,
+    make_policy_optimizer=make_async_replay_optimizer)

@@ -53,14 +53,26 @@ def make_async_replay_optimizer(workers, config):
 def setup_apex_exploration(trainer):
     """eps_i = 0.4^(1 + 7*i/(N-1)) per Ape-X (reference:
     `dqn_policy.py` exploration setup under per_worker_exploration)."""
+    from ...utils.schedules import LinearSchedule
     trainer._last_target_update_ts = 0
     trainer._num_target_updates = 0
     workers = trainer.workers.remote_workers
     n = max(1, len(workers))
-    trainer.get_policy().set_epsilon(0.0)  # learner-side greedy
-    for i, w in enumerate(workers):
-        exponent = 1.0 + (i / max(1, n - 1)) * 7.0
-        w.apply.remote(_set_eps, 0.4 ** exponent)
+    if workers:
+        trainer.get_policy().set_epsilon(0.0)  # learner-side greedy
+        trainer._eps_schedule = None
+        for i, w in enumerate(workers):
+            exponent = 1.0 + (i / max(1, n - 1)) * 7.0
+            w.apply.remote(_set_eps, 0.4 ** exponent)
+    else:
+        # num_workers=0: the learner policy is also the only sampler, so
+        # it needs an annealed exploration schedule like plain DQN.
+        trainer._eps_schedule = LinearSchedule(
+            trainer.config["exploration_timesteps"],
+            initial_p=trainer.config["exploration_initial_eps"],
+            final_p=trainer.config["exploration_final_eps"])
+        trainer.get_policy().set_epsilon(
+            trainer.config["exploration_initial_eps"])
 
 
 def _set_eps(worker, eps):
@@ -68,6 +80,9 @@ def _set_eps(worker, eps):
 
 
 def apex_update_target(trainer, fetches):
+    if trainer._eps_schedule is not None:  # local (num_workers=0) mode
+        trainer.get_policy().set_epsilon(trainer._eps_schedule.value(
+            trainer.optimizer.num_steps_sampled))
     ts = trainer.optimizer.num_steps_trained
     if ts - trainer._last_target_update_ts >= \
             trainer.config["target_network_update_freq"]:

@@ -105,11 +105,13 @@ def _set_eps(worker, eps):
 
 
 def update_target_and_epsilon(trainer, fetches):
-    """Per-iteration hooks: anneal epsilon from global samples, sync the
-    target network on schedule (parity: dqn.py `update_target_if_needed` +
-    per-worker exploration update)."""
-    ts = trainer.optimizer.num_steps_sampled
-    _sync_epsilon(trainer, trainer._eps_schedule.value(ts))
+    """Per-step hooks: anneal epsilon from global SAMPLED steps, sync the
+    target network on TRAINED steps (parity: dqn.py
+    `update_target_if_needed` keys the target schedule on
+    optimizer.num_steps_trained)."""
+    _sync_epsilon(trainer, trainer._eps_schedule.value(
+        trainer.optimizer.num_steps_sampled))
+    ts = trainer.optimizer.num_steps_trained
     if ts - trainer._last_target_update_ts >= \
             trainer.config["target_network_update_freq"]:
         trainer.get_policy().update_target()

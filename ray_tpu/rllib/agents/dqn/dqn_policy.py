@@ -132,9 +132,16 @@ class DQNPolicy(JaxPolicy):
 
         def make_model(obs_space, act_space, model_cfg):
             mcfg = model_cfg.get("model") or {}
+            # Reference layering: the catalog model (fcnet_hiddens) feeds
+            # the Q-head stack (`hiddens`) — honored here as trunk sizes
+            # fcnet_hiddens ++ hiddens (conv trunk replaces fcnet for
+            # image obs).
+            trunk = tuple(mcfg.get("fcnet_hiddens") or ()) \
+                if len(obs_space.shape) < 3 else ()
             return QNetwork(
                 num_actions=act_space.n,
-                hiddens=tuple(cfg["hiddens"]),
+                hiddens=trunk + tuple(cfg["hiddens"]),
+                activation=mcfg.get("fcnet_activation", "relu"),
                 dueling=cfg["dueling"],
                 conv_filters=tuple(
                     tuple(f) for f in

@@ -1,0 +1,77 @@
+"""`rllib rollout`-equivalent CLI: evaluate a trained checkpoint.
+
+Parity: `rllib/rollout.py` — restore a trainer from a checkpoint and run
+episodes with the greedy policy, printing per-episode rewards.
+
+Usage:
+    python -m ray_tpu.rllib.rollout <checkpoint> --run PPO \
+        --env CartPole-v0 --episodes 5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import numpy as np
+
+
+def create_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="rllib rollout")
+    p.add_argument("checkpoint", help="trainer checkpoint path")
+    p.add_argument("--run", required=True, help="algorithm name")
+    p.add_argument("--env", required=True, help="environment id")
+    p.add_argument("--episodes", type=int, default=5)
+    p.add_argument("--steps", type=int, default=10000,
+                   help="max total env steps")
+    p.add_argument("--config", default="{}",
+                   help="JSON config overrides (must match training)")
+    p.add_argument("--no-render", action="store_true", default=True)
+    return p
+
+
+def rollout(trainer, env_name: str, num_steps: int,
+            num_episodes: int) -> list:
+    from .env.registry import make_env
+    env = make_env(env_name, {})
+    rewards = []
+    steps = 0
+    for _ in range(num_episodes):
+        obs = env.reset()
+        done = False
+        total = 0.0
+        while not done and steps < num_steps:
+            action = trainer.compute_action(obs, explore=False)
+            obs, r, done, _ = env.step(action)
+            total += float(r)
+            steps += 1
+        rewards.append(total)
+        print(f"episode reward: {total}")
+        if steps >= num_steps:
+            break
+    return rewards
+
+
+def run(args, parser):
+    from .agents.registry import get_trainer_class
+    cls = get_trainer_class(args.run)
+    config = json.loads(args.config)
+    config["env"] = args.env
+    config.setdefault("num_workers", 0)
+    trainer = cls(config=config)
+    trainer.restore(args.checkpoint)
+    rewards = rollout(trainer, args.env, args.steps, args.episodes)
+    print(f"mean reward over {len(rewards)} episodes: "
+          f"{np.mean(rewards):.2f}")
+    trainer.stop()
+    return rewards
+
+
+def main(argv=None):
+    parser = create_parser()
+    return run(parser.parse_args(argv), parser)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

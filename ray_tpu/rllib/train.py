@@ -1,0 +1,97 @@
+"""`rllib train`-equivalent CLI.
+
+Parity: `rllib/train.py:131` — builds an experiment dict from CLI args or
+a tuned-example yaml and hands it to `tune.run_experiments`.
+
+Usage:
+    python -m ray_tpu.rllib.train --run PPO --env CartPole-v0 \
+        --stop '{"training_iteration": 10}' --config '{"num_workers": 2}'
+    python -m ray_tpu.rllib.train -f tuned_examples/cartpole-ppo.yaml
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import yaml
+
+
+def create_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="rllib train",
+        description="Train a reinforcement learning agent.")
+    parser.add_argument("-f", "--config-file", default=None,
+                        help="experiment yaml (tuned_examples format)")
+    parser.add_argument("--run", default=None,
+                        help="algorithm name (PPO, IMPALA, DQN, APEX, ...)")
+    parser.add_argument("--env", default=None, help="environment id")
+    parser.add_argument("--stop", default="{}",
+                        help="JSON stop criteria, e.g. "
+                        "'{\"training_iteration\": 10}'")
+    parser.add_argument("--config", default="{}",
+                        help="JSON algorithm config overrides")
+    parser.add_argument("--experiment-name", default="default",
+                        help="result dir name under local-dir")
+    parser.add_argument("--local-dir", default=None,
+                        help="results root (default ~/ray_tpu_results)")
+    parser.add_argument("--num-samples", type=int, default=1)
+    parser.add_argument("--checkpoint-freq", type=int, default=0)
+    parser.add_argument("--checkpoint-at-end", action="store_true")
+    parser.add_argument("--resume", action="store_true")
+    parser.add_argument("-v", action="store_true", dest="verbose")
+    return parser
+
+
+def run(args, parser: argparse.ArgumentParser):
+    from ray_tpu import tune
+
+    if args.config_file:
+        with open(args.config_file) as f:
+            experiments = yaml.safe_load(f)
+    else:
+        if not args.run:
+            parser.error("--run is required (or -f <yaml>)")
+        if not args.env:
+            parser.error("--env is required (or -f <yaml>)")
+        config = json.loads(args.config)
+        config.setdefault("env", args.env)
+        experiments = {
+            args.experiment_name: {
+                "run": args.run,
+                "env": args.env,
+                "stop": json.loads(args.stop),
+                "config": config,
+                "num_samples": args.num_samples,
+                "local_dir": args.local_dir,
+                "checkpoint_freq": args.checkpoint_freq,
+                "checkpoint_at_end": args.checkpoint_at_end,
+            }
+        }
+
+    for name, spec in experiments.items():
+        # yaml specs put env at top level (reference convention).
+        if "env" in spec:
+            spec.setdefault("config", {}).setdefault(
+                "env", spec.pop("env"))
+        if spec.get("local_dir") is None:
+            spec.pop("local_dir", None)
+
+    analysis = tune.run_experiments(experiments, resume=args.resume,
+                                    verbose=1 if args.verbose else 0)
+    best = analysis.get_best_trial()
+    if best is not None:
+        print(f"best trial: {best} -> "
+              f"{best.last_result.get('episode_reward_mean')}")
+    return analysis
+
+
+def main(argv=None):
+    parser = create_parser()
+    args = parser.parse_args(argv)
+    return run(args, parser)
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

@@ -1,0 +1,3 @@
+from .jax_trainer import JaxRunner, JaxTrainer
+
+__all__ = ["JaxRunner", "JaxTrainer"]

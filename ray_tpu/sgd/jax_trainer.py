@@ -1,0 +1,358 @@
+"""Data-parallel supervised training (the Ray SGD equivalent).
+
+Parity: `python/ray/experimental/sgd/pytorch/pytorch_trainer.py:23`
+(`PyTorchTrainer`) + `distributed_pytorch_runner.py` — N runner actors,
+synchronized data-parallel SGD, fault-tolerant `train(max_retries)` that
+shrinks the world after an actor death, `save`/`restore` of model +
+optimizer state.
+
+TPU re-architecture: the reference's NCCL allreduce
+(`pytorch_trainer.py:90`, `distributed_pytorch_runner.py:47,62`) splits
+into two planes:
+
+- **Intra-host (the fast path)**: each runner jits ONE donated-buffer
+  train step over its device mesh; the batch is sharded on the "dp" axis
+  and XLA inserts the gradient psum over ICI. With `num_replicas=0`
+  everything runs in-process on the full mesh — this is the TPU-native
+  replacement for DDP on a single machine.
+- **Inter-host**: runner actors exchange gradients through the object
+  store (driver-averaged, synchronous), standing in for DCN allreduce;
+  `jax.distributed`-backed multi-host pods plug in here.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ray_tpu
+from ray_tpu.exceptions import RayError
+
+from ..parallel import mesh as mesh_lib
+
+logger = logging.getLogger(__name__)
+
+
+class JaxRunner:
+    """One data-parallel worker: model replica + data shard.
+
+    Parity: `distributed_pytorch_runner.py` — created as an actor by
+    JaxTrainer (or used inline for num_replicas=0).
+    """
+
+    def __init__(self, model_creator: Callable, data_creator: Callable,
+                 optimizer_creator: Callable, loss_creator: Callable,
+                 config: Optional[dict] = None,
+                 batch_size: int = 64,
+                 num_devices: int = 0):
+        self.config = dict(config or {})
+        self.batch_size = batch_size
+        self.model_creator = model_creator
+        self.data_creator = data_creator
+        self.optimizer_creator = optimizer_creator
+        self.loss_creator = loss_creator
+        self.num_devices = num_devices
+        self.epoch = 0
+
+    def setup(self, world_size: int = 1, world_rank: int = 0):
+        """Build model/opt/data; shard the dataset by rank (parity:
+        DistributedSampler in `distributed_pytorch_runner.py:62`)."""
+        self.world_size = world_size
+        self.world_rank = world_rank
+        self.mesh = mesh_lib.make_mesh(
+            num_devices=self.num_devices or None)
+        n_dev = self.mesh.devices.size
+        self._repl = mesh_lib.replicated(self.mesh)
+        self._bshard = mesh_lib.batch_sharded(self.mesh)
+
+        self.model = self.model_creator(self.config)
+        self.optimizer = self.optimizer_creator(self.config)
+        self.loss_fn = self.loss_creator(self.config)
+
+        data = self.data_creator(self.config)
+        if isinstance(data, tuple) and len(data) == 2:
+            train_data, val_data = data
+        else:
+            train_data, val_data = data, None
+        # Shard rows rank::world_size (DistributedSampler semantics).
+        self.train_x, self.train_y = [
+            np.asarray(a)[self.world_rank::self.world_size]
+            for a in train_data]
+        self.val = None
+        if val_data is not None:
+            self.val = tuple(np.asarray(a) for a in val_data)
+
+        rng = jax.random.PRNGKey(self.config.get("seed", 0))
+        dummy = self.train_x[:1]
+        self.params = mesh_lib.put_replicated(
+            self.model.init(rng, jnp.asarray(dummy)), self.mesh)
+        self.opt_state = mesh_lib.put_replicated(
+            self.optimizer.init(self.params), self.mesh)
+
+        def train_step(params, opt_state, x, y):
+            def batch_loss(p):
+                pred = self.model.apply(p, x)
+                return self.loss_fn(pred, y)
+            loss, grads = jax.value_and_grad(batch_loss)(params)
+            updates, opt_state = self.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        # Donated params/opt + dp-sharded batch: XLA inserts the gradient
+        # all-reduce over the mesh (ICI), replacing NCCL.
+        self._train_step = jax.jit(
+            train_step, donate_argnums=(0, 1),
+            in_shardings=(self._repl, self._repl, self._bshard,
+                          self._bshard),
+            out_shardings=(self._repl, self._repl, self._repl))
+
+        def grad_step(params, x, y):
+            def batch_loss(p):
+                pred = self.model.apply(p, x)
+                return self.loss_fn(pred, y)
+            loss, grads = jax.value_and_grad(batch_loss)(params)
+            return grads, loss
+
+        self._grad_step = jax.jit(
+            grad_step,
+            in_shardings=(self._repl, self._bshard, self._bshard),
+            out_shardings=(self._repl, self._repl))
+
+        def eval_step(params, x, y):
+            pred = self.model.apply(params, x)
+            return self.loss_fn(pred, y)
+
+        self._eval_step = jax.jit(eval_step)
+        self._perm_rng = np.random.RandomState(
+            self.config.get("seed", 0) + self.world_rank)
+        return n_dev
+
+    # -- local (intra-host) training -------------------------------------
+    def _batches(self):
+        n = len(self.train_x)
+        per = mesh_lib.pad_to_multiple(
+            self.batch_size, self.mesh.devices.size)
+        idx = self._perm_rng.permutation(n)
+        for start in range(0, n - per + 1, per):
+            sel = idx[start:start + per]
+            yield self.train_x[sel], self.train_y[sel]
+
+    def train_epoch(self) -> Dict:
+        """One pass over the local shard, all-reducing over the local
+        mesh (parity: `train` in distributed_pytorch_runner)."""
+        losses = []
+        t0 = time.time()
+        count = 0
+        for x, y in self._batches():
+            self.params, self.opt_state, loss = self._train_step(
+                self.params, self.opt_state, jnp.asarray(x),
+                jnp.asarray(y))
+            losses.append(loss)
+            count += len(x)
+        self.epoch += 1
+        mean_loss = float(jnp.mean(jnp.stack(losses))) if losses else 0.0
+        return {"train_loss": mean_loss, "epoch": self.epoch,
+                "num_samples": count,
+                "time_s": round(time.time() - t0, 3)}
+
+    # -- cross-host gradient exchange ------------------------------------
+    def compute_gradients(self, weights) -> tuple:
+        """Grads for one minibatch at the given weights (driver-averaged
+        synchronous data parallelism across runners)."""
+        if weights is not None:
+            self.set_weights(weights)
+        n = len(self.train_x)
+        per = mesh_lib.pad_to_multiple(
+            self.batch_size, self.mesh.devices.size)
+        sel = self._perm_rng.randint(0, n, size=per)
+        grads, loss = self._grad_step(
+            self.params, jnp.asarray(self.train_x[sel]),
+            jnp.asarray(self.train_y[sel]))
+        return jax.tree.map(np.asarray, grads), float(loss)
+
+    def apply_gradients(self, grads):
+        updates, self.opt_state = self.optimizer.update(
+            jax.tree.map(jnp.asarray, grads), self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+
+    # -- evaluation / state ----------------------------------------------
+    def validate(self) -> Dict:
+        if self.val is None:
+            return {}
+        x, y = self.val
+        loss = float(self._eval_step(
+            self.params, jnp.asarray(x), jnp.asarray(y)))
+        return {"validation_loss": loss}
+
+    def get_weights(self):
+        return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        self.params = mesh_lib.put_replicated(weights, self.mesh)
+
+    def get_state(self) -> Dict:
+        return {"params": self.get_weights(),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "epoch": self.epoch}
+
+    def set_state(self, state: Dict):
+        self.set_weights(state["params"])
+        self.opt_state = mesh_lib.put_replicated(
+            jax.tree.map(jnp.asarray, state["opt_state"]), self.mesh)
+        self.epoch = state["epoch"]
+
+    def ping(self):
+        return "ok"
+
+
+class JaxTrainer:
+    """Parity: `PyTorchTrainer` (`pytorch_trainer.py:23`).
+
+    num_replicas=0: in-process training over the full device mesh (the
+    TPU path). num_replicas>=1: runner actors, one shard each, synchronous
+    weight-averaged epochs, elastic recovery on actor death.
+    """
+
+    def __init__(self,
+                 model_creator: Callable,
+                 data_creator: Callable,
+                 optimizer_creator: Callable,
+                 loss_creator: Callable,
+                 config: Optional[dict] = None,
+                 num_replicas: int = 0,
+                 batch_size: int = 64,
+                 num_devices_per_replica: int = 0):
+        self._ctor_args = (model_creator, data_creator, optimizer_creator,
+                           loss_creator)
+        self.config = dict(config or {})
+        self.batch_size = batch_size
+        self.num_replicas = num_replicas
+        self.num_devices_per_replica = num_devices_per_replica
+        if num_replicas <= 0:
+            self.local_runner = JaxRunner(
+                *self._ctor_args, config=self.config,
+                batch_size=batch_size,
+                num_devices=num_devices_per_replica)
+            self.local_runner.setup(1, 0)
+            self.runners: List = []
+        else:
+            self.local_runner = None
+            self._start_runners(num_replicas)
+
+    def _start_runners(self, n: int):
+        RemoteRunner = ray_tpu.remote(JaxRunner)
+        self.runners = [
+            RemoteRunner.options(num_cpus=1).remote(
+                *self._ctor_args, config=self.config,
+                batch_size=self.batch_size,
+                num_devices=self.num_devices_per_replica)
+            for _ in range(n)]
+        ray_tpu.get([r.setup.remote(n, i)
+                     for i, r in enumerate(self.runners)])
+
+    # ------------------------------------------------------------------
+    def train(self, max_retries: int = 0) -> Dict:
+        """One epoch. With actors: each runner trains its shard, then
+        weights average (synchronous model averaging per epoch); actor
+        death shrinks the world and retries (parity:
+        `pytorch_trainer.py:167` train/max_retries)."""
+        for attempt in range(max_retries + 1):
+            try:
+                return self._train_once()
+            except RayError:
+                if attempt >= max_retries:
+                    raise
+                logger.warning("runner failure; shrinking world and "
+                               "retrying (%d/%d)", attempt + 1,
+                               max_retries)
+                self._recover()
+        raise RuntimeError("unreachable")
+
+    def _train_once(self) -> Dict:
+        if self.local_runner is not None:
+            return self.local_runner.train_epoch()
+        stats = ray_tpu.get([r.train_epoch.remote() for r in self.runners])
+        self._average_weights()
+        out = {k: float(np.mean([s[k] for s in stats]))
+               for k in ("train_loss", "time_s")}
+        out["epoch"] = int(max(s["epoch"] for s in stats))
+        out["num_samples"] = int(sum(s["num_samples"] for s in stats))
+        return out
+
+    def _average_weights(self):
+        all_w = ray_tpu.get([r.get_weights.remote() for r in self.runners])
+        mean_w = jax.tree.map(
+            lambda *xs: np.mean(np.stack(xs), axis=0), *all_w)
+        ref = ray_tpu.put(mean_w)
+        ray_tpu.get([r.set_weights.remote(ref) for r in self.runners])
+
+    def _recover(self):
+        alive = []
+        for r in self.runners:
+            try:
+                ray_tpu.get(r.ping.remote(), timeout=10)
+                alive.append(r)
+            except Exception:
+                pass
+        if not alive:
+            raise RuntimeError("all runners died")
+        state = ray_tpu.get(alive[0].get_state.remote())
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        # Shrunk world: re-create the fleet at the surviving size
+        # (reference shrinks then re-grows when resources return).
+        self._start_runners(len(alive))
+        ref = ray_tpu.put(state)
+        ray_tpu.get([r.set_state.remote(ref) for r in self.runners])
+
+    # ------------------------------------------------------------------
+    def validate(self) -> Dict:
+        if self.local_runner is not None:
+            return self.local_runner.validate()
+        stats = ray_tpu.get([r.validate.remote() for r in self.runners])
+        stats = [s for s in stats if s]
+        if not stats:
+            return {}
+        return {"validation_loss": float(
+            np.mean([s["validation_loss"] for s in stats]))}
+
+    def get_model_weights(self):
+        if self.local_runner is not None:
+            return self.local_runner.get_weights()
+        return ray_tpu.get(self.runners[0].get_weights.remote())
+
+    def save(self, path: str) -> str:
+        import pickle
+        state = self.local_runner.get_state() if self.local_runner \
+            else ray_tpu.get(self.runners[0].get_state.remote())
+        with open(path, "wb") as f:
+            pickle.dump(state, f)
+        return path
+
+    def restore(self, path: str):
+        import pickle
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        if self.local_runner is not None:
+            self.local_runner.set_state(state)
+        else:
+            ref = ray_tpu.put(state)
+            ray_tpu.get([r.set_state.remote(ref) for r in self.runners])
+
+    def shutdown(self):
+        for r in self.runners:
+            try:
+                ray_tpu.kill(r)
+            except Exception:
+                pass
+        self.runners = []

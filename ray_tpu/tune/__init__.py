@@ -1,1 +1,26 @@
+"""ray_tpu.tune: experiment execution and hyperparameter tuning.
+
+Parity: `python/ray/tune/` — `tune.run`/`run_experiments` drive trials
+(remote Trainable actors) through a TrialRunner with pluggable schedulers
+(ASHA, HyperBand, PBT, median-stopping) and grid/random search.
+"""
+
+from .analysis import ExperimentAnalysis  # noqa: F401
+from .experiment import Experiment  # noqa: F401
+from .logger import (CSVLogger, JsonLogger, Logger, TBXLogger,  # noqa: F401
+                     UnifiedLogger)
+from .registry import get_trainable_cls, register_trainable  # noqa: F401
+from .sample import (choice, function, grid_search, loguniform,  # noqa: F401
+                     randint, randn, sample_from, uniform)
 from .trainable import Trainable  # noqa: F401
+from .trial import Trial  # noqa: F401
+from .trial_runner import TrialRunner  # noqa: F401
+from .tune import run, run_experiments  # noqa: F401
+
+__all__ = [
+    "CSVLogger", "Experiment", "ExperimentAnalysis", "JsonLogger",
+    "Logger", "TBXLogger", "Trainable", "Trial", "TrialRunner",
+    "UnifiedLogger", "choice", "function", "get_trainable_cls",
+    "grid_search", "loguniform", "randint", "randn", "register_trainable",
+    "run", "run_experiments", "sample_from", "uniform",
+]

@@ -1,0 +1,96 @@
+"""ExperimentAnalysis: inspect finished experiments.
+
+Parity: `python/ray/tune/analysis/experiment_analysis.py` — best trial /
+config / checkpoint lookup plus per-trial result dataframes loaded from
+the JsonLogger output.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional
+
+from .checkpoint_manager import Checkpoint
+from .trial import Trial
+
+
+class ExperimentAnalysis:
+    def __init__(self, trials: List[Trial],
+                 default_metric: str = "episode_reward_mean",
+                 default_mode: str = "max"):
+        self.trials = trials
+        self.default_metric = default_metric
+        self.default_mode = default_mode
+
+    # ------------------------------------------------------------------
+    def _metric_mode(self, metric, mode):
+        return metric or self.default_metric, mode or self.default_mode
+
+    def get_best_trial(self, metric: Optional[str] = None,
+                       mode: Optional[str] = None) -> Optional[Trial]:
+        metric, mode = self._metric_mode(metric, mode)
+        sign = 1.0 if mode == "max" else -1.0
+        best, best_v = None, float("-inf")
+        for t in self.trials:
+            if metric not in t.last_result:
+                continue
+            v = sign * t.last_result[metric]
+            if v > best_v:
+                best, best_v = t, v
+        return best
+
+    def get_best_config(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Optional[dict]:
+        t = self.get_best_trial(metric, mode)
+        return t.config if t else None
+
+    def get_best_logdir(self, metric: Optional[str] = None,
+                        mode: Optional[str] = None) -> Optional[str]:
+        t = self.get_best_trial(metric, mode)
+        return t.logdir if t else None
+
+    def get_best_checkpoint(self, trial: Optional[Trial] = None,
+                            metric: Optional[str] = None,
+                            mode: Optional[str] = None):
+        trial = trial or self.get_best_trial(metric, mode)
+        if trial is None:
+            return None
+        ckpt = trial.checkpoint_manager.best_checkpoint()
+        return ckpt.value if ckpt and ckpt.storage == Checkpoint.DISK \
+            else None
+
+    # ------------------------------------------------------------------
+    def trial_dataframes(self) -> Dict[str, list]:
+        """trial_id -> list of result dicts (from result.json)."""
+        out = {}
+        for t in self.trials:
+            rows = []
+            if t.logdir:
+                path = os.path.join(t.logdir, "result.json")
+                if os.path.exists(path):
+                    with open(path) as f:
+                        rows = [json.loads(line) for line in f if
+                                line.strip()]
+            out[t.trial_id] = rows
+        return out
+
+    def dataframe(self):
+        """All trials' last results as a pandas DataFrame (if available)."""
+        import pandas as pd
+        rows = []
+        for t in self.trials:
+            row = {"trial_id": t.trial_id, "status": t.status,
+                   "logdir": t.logdir}
+            row.update({k: v for k, v in t.last_result.items()
+                        if isinstance(v, (int, float, str, bool))})
+            for k, v in t.evaluated_params.items():
+                row[f"config/{k}"] = v
+            rows.append(row)
+        return pd.DataFrame(rows)
+
+    def stats(self) -> dict:
+        by_status: Dict[str, int] = {}
+        for t in self.trials:
+            by_status[t.status] = by_status.get(t.status, 0) + 1
+        return by_status

@@ -1,0 +1,62 @@
+"""Keep the best-K on-disk checkpoints per trial.
+
+Parity: `python/ray/tune/checkpoint_manager.py:42` (`CheckpointManager`) —
+ordered by a score attribute, deleting evicted checkpoint files.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+import os
+import shutil
+from typing import Optional
+
+
+class Checkpoint:
+    DISK = "disk"
+    MEMORY = "memory"
+
+    def __init__(self, storage: str, value, result: Optional[dict] = None):
+        self.storage = storage
+        self.value = value        # path (disk) or blob (memory)
+        self.result = result or {}
+
+    def delete(self):
+        if self.storage == Checkpoint.DISK and self.value and \
+                os.path.exists(os.path.dirname(self.value)):
+            shutil.rmtree(os.path.dirname(self.value), ignore_errors=True)
+
+
+class CheckpointManager:
+    def __init__(self, keep_checkpoints_num=float("inf"),
+                 checkpoint_score_attr: str = "training_iteration"):
+        self.keep_num = keep_checkpoints_num
+        if checkpoint_score_attr.startswith("min-"):
+            self._attr = checkpoint_score_attr[4:]
+            self._sign = -1.0
+        else:
+            self._attr = checkpoint_score_attr
+            self._sign = 1.0
+        self._newest: Optional[Checkpoint] = None
+        self._heap = []          # min-heap of (score, seq, ckpt)
+        self._seq = itertools.count()
+
+    def on_checkpoint(self, ckpt: Checkpoint):
+        self._newest = ckpt
+        if ckpt.storage == Checkpoint.MEMORY:
+            return
+        score = self._sign * ckpt.result.get(self._attr, 0)
+        heapq.heappush(self._heap, (score, next(self._seq), ckpt))
+        while len(self._heap) > self.keep_num:
+            _, _, evicted = heapq.heappop(self._heap)
+            if evicted is not self._newest:
+                evicted.delete()
+
+    def newest_checkpoint(self) -> Optional[Checkpoint]:
+        return self._newest
+
+    def best_checkpoint(self) -> Optional[Checkpoint]:
+        if not self._heap:
+            return self._newest
+        return max(self._heap)[2]

@@ -1,0 +1,69 @@
+"""Experiment spec.
+
+Parity: `python/ray/tune/experiment.py` — normalizes the
+`tune.run(...)` / yaml experiment dict into one object the variant
+generator consumes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Union
+
+
+class Experiment:
+    def __init__(self,
+                 name: str,
+                 run: Union[str, type, Callable],
+                 stop: Optional[dict] = None,
+                 config: Optional[dict] = None,
+                 num_samples: int = 1,
+                 local_dir: Optional[str] = None,
+                 checkpoint_freq: int = 0,
+                 checkpoint_at_end: bool = False,
+                 keep_checkpoints_num: Optional[int] = None,
+                 checkpoint_score_attr: str = "training_iteration",
+                 max_failures: int = 0,
+                 restore: Optional[str] = None):
+        from .registry import get_trainable_cls, register_trainable
+        if not isinstance(run, str):
+            # Register under a readable name so trials can respawn it.
+            run_name = getattr(run, "__name__", "trainable")
+            register_trainable(run_name, run)
+            run = run_name
+        else:
+            get_trainable_cls(run)  # validate early
+        self.name = name or run
+        self.run = run
+        self.stop = stop or {}
+        self.config = config or {}
+        self.num_samples = num_samples
+        base = local_dir or os.path.expanduser("~/ray_tpu_results")
+        self.local_dir = os.path.join(base, self.name)
+        self.checkpoint_freq = checkpoint_freq
+        self.checkpoint_at_end = checkpoint_at_end
+        self.keep_checkpoints_num = keep_checkpoints_num
+        self.checkpoint_score_attr = checkpoint_score_attr
+        self.max_failures = max_failures
+        self.restore = restore
+
+    @classmethod
+    def from_json(cls, name: str, spec: dict) -> "Experiment":
+        """Build from a yaml/dict experiment entry (reference:
+        `tune/config_parser.py` + `Experiment.from_json`)."""
+        spec = dict(spec)
+        run = spec.pop("run")
+        return cls(
+            name=name,
+            run=run,
+            stop=spec.pop("stop", None),
+            config=spec.pop("config", None),
+            num_samples=spec.pop("num_samples", 1),
+            local_dir=spec.pop("local_dir", None),
+            checkpoint_freq=spec.pop("checkpoint_freq", 0),
+            checkpoint_at_end=spec.pop("checkpoint_at_end", False),
+            keep_checkpoints_num=spec.pop("keep_checkpoints_num", None),
+            checkpoint_score_attr=spec.pop(
+                "checkpoint_score_attr", "training_iteration"),
+            max_failures=spec.pop("max_failures", 0),
+            restore=spec.pop("restore", None))

@@ -1,0 +1,78 @@
+"""Function-API trainables.
+
+Parity: `python/ray/tune/function_runner.py` — a user function
+`f(config, reporter)` runs on a background thread; each `reporter(...)`
+call yields one `train()` result to the driver side.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Optional
+
+from .trainable import Trainable
+
+ERROR_SENTINEL = object()
+DONE_SENTINEL = object()
+
+
+class StatusReporter:
+    def __init__(self, result_queue: "queue.Queue"):
+        self._queue = result_queue
+        self._last_report_time = time.time()
+
+    def __call__(self, **kwargs):
+        self._queue.put(dict(kwargs))
+        self._last_report_time = time.time()
+
+
+def wrap_function(train_func: Callable) -> type:
+    """Returns a Trainable class driving `train_func(config, reporter)`."""
+
+    class WrappedFunc(FunctionRunner):
+        _func = staticmethod(train_func)
+        __name__ = getattr(train_func, "__name__", "func")
+
+    WrappedFunc.__qualname__ = WrappedFunc.__name__
+    return WrappedFunc
+
+
+class FunctionRunner(Trainable):
+    _func: Optional[Callable] = None
+
+    def _setup(self, config):
+        # maxsize=1: the function blocks until the driver consumes each
+        # result (reference handoff semantics) — keeps the trainable in
+        # lockstep with scheduler decisions and bounds memory.
+        self._results: "queue.Queue" = queue.Queue(maxsize=1)
+        self._reporter = StatusReporter(self._results)
+
+        def runner():
+            try:
+                self._func(dict(config), self._reporter)
+                self._results.put(DONE_SENTINEL)
+            except Exception as e:
+                self._error = e
+                self._results.put(ERROR_SENTINEL)
+
+        self._error = None
+        self._thread = threading.Thread(target=runner, daemon=True)
+        self._thread.start()
+
+    def _train(self):
+        item = self._results.get()
+        if item is ERROR_SENTINEL:
+            raise self._error
+        if item is DONE_SENTINEL:
+            return {"done": True}
+        return item
+
+    def _save(self, checkpoint_dir):
+        raise NotImplementedError(
+            "function-API trainables do not support checkpointing; use "
+            "the class API (parity: reference function_runner)")
+
+    def _restore(self, checkpoint_path):
+        raise NotImplementedError

@@ -1,0 +1,170 @@
+"""Result loggers.
+
+Parity: `python/ray/tune/logger.py` — `JsonLogger` (:100), `CSVLogger`
+(:277), `TBXLogger` (:315), `UnifiedLogger` (:383). TensorBoard output
+uses torch's SummaryWriter when available (the image has torch).
+"""
+
+from __future__ import annotations
+
+import csv
+import json
+import logging
+import os
+from typing import List, Optional
+
+import numpy as np
+
+logger = logging.getLogger(__name__)
+
+
+class Logger:
+    def __init__(self, config: dict, logdir: str):
+        self.config = config
+        self.logdir = logdir
+        self._init()
+
+    def _init(self):
+        pass
+
+    def on_result(self, result: dict):
+        raise NotImplementedError
+
+    def update_config(self, config: dict):
+        self.config = config
+
+    def flush(self):
+        pass
+
+    def close(self):
+        pass
+
+
+class _SafeJson(json.JSONEncoder):
+    def default(self, o):
+        if isinstance(o, np.integer):
+            return int(o)
+        if isinstance(o, np.floating):
+            return float(o)
+        if isinstance(o, np.ndarray):
+            return o.tolist()
+        try:
+            return super().default(o)
+        except TypeError:
+            return str(o)
+
+
+class JsonLogger(Logger):
+    def _init(self):
+        config_path = os.path.join(self.logdir, "params.json")
+        with open(config_path, "w") as f:
+            json.dump(self.config, f, cls=_SafeJson, indent=2)
+        self._file = open(os.path.join(self.logdir, "result.json"), "a")
+
+    def on_result(self, result: dict):
+        json.dump(result, self._file, cls=_SafeJson)
+        self._file.write("\n")
+        self._file.flush()
+
+    def update_config(self, config):
+        super().update_config(config)
+        with open(os.path.join(self.logdir, "params.json"), "w") as f:
+            json.dump(config, f, cls=_SafeJson, indent=2)
+
+    def close(self):
+        self._file.close()
+
+
+def _flatten(d: dict, prefix: str = "") -> dict:
+    out = {}
+    for k, v in d.items():
+        key = f"{prefix}/{k}" if prefix else str(k)
+        if isinstance(v, dict):
+            out.update(_flatten(v, key))
+        else:
+            out[key] = v
+    return out
+
+
+class CSVLogger(Logger):
+    def _init(self):
+        self._file = open(os.path.join(self.logdir, "progress.csv"), "a")
+        self._writer = None
+
+    def on_result(self, result: dict):
+        flat = _flatten({k: v for k, v in result.items()
+                         if not isinstance(v, (list, np.ndarray))})
+        scalar = {k: v for k, v in flat.items()
+                  if isinstance(v, (int, float, str, bool, np.number))}
+        if self._writer is None:
+            self._writer = csv.DictWriter(self._file,
+                                          fieldnames=sorted(scalar))
+            self._writer.writeheader()
+        self._writer.writerow(
+            {k: scalar.get(k, "") for k in self._writer.fieldnames})
+        self._file.flush()
+
+    def close(self):
+        self._file.close()
+
+
+class TBXLogger(Logger):
+    """TensorBoard scalars via torch.utils.tensorboard (optional)."""
+
+    def _init(self):
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+            self._writer = SummaryWriter(self.logdir)
+        except Exception:
+            logger.debug("tensorboard writer unavailable; TBXLogger off")
+            self._writer = None
+
+    def on_result(self, result: dict):
+        if self._writer is None:
+            return
+        step = result.get("training_iteration", 0)
+        for k, v in _flatten(result).items():
+            if isinstance(v, (int, float, np.number)) and np.isfinite(v):
+                self._writer.add_scalar(k, float(v), global_step=step)
+
+    def flush(self):
+        if self._writer is not None:
+            self._writer.flush()
+
+    def close(self):
+        if self._writer is not None:
+            self._writer.close()
+
+
+DEFAULT_LOGGERS = (JsonLogger, CSVLogger, TBXLogger)
+
+
+class UnifiedLogger(Logger):
+    def __init__(self, config: dict, logdir: str,
+                 loggers: Optional[List] = None):
+        self._logger_classes = loggers or list(DEFAULT_LOGGERS)
+        super().__init__(config, logdir)
+
+    def _init(self):
+        self._loggers = []
+        for cls in self._logger_classes:
+            try:
+                self._loggers.append(cls(self.config, self.logdir))
+            except Exception:
+                logger.exception("could not start logger %s", cls)
+
+    def on_result(self, result: dict):
+        for lg in self._loggers:
+            lg.on_result(result)
+
+    def update_config(self, config):
+        for lg in self._loggers:
+            lg.update_config(config)
+
+    def flush(self):
+        for lg in self._loggers:
+            lg.flush()
+
+    def close(self):
+        for lg in self._loggers:
+            lg.close()

@@ -1,0 +1,46 @@
+"""Trainable registry.
+
+Parity: `python/ray/tune/registry.py` — `register_trainable` /
+`register_env`; string names also resolve RLlib algorithms ("PPO", ...)
+like the reference's `get_agent_class` fallback.
+"""
+
+from __future__ import annotations
+
+import inspect
+from typing import Callable, Dict, Union
+
+_TRAINABLES: Dict[str, type] = {}
+
+
+def register_trainable(name: str, trainable) -> None:
+    from .function_runner import wrap_function
+    from .trainable import Trainable
+    if inspect.isclass(trainable) and issubclass(trainable, Trainable):
+        _TRAINABLES[name] = trainable
+    elif callable(trainable):
+        _TRAINABLES[name] = wrap_function(trainable)
+    else:
+        raise TypeError(f"cannot register {trainable!r} as a trainable")
+
+
+def get_trainable_cls(name_or_cls: Union[str, type, Callable]) -> type:
+    from .function_runner import wrap_function
+    from .trainable import Trainable
+    if inspect.isclass(name_or_cls) and issubclass(name_or_cls, Trainable):
+        return name_or_cls
+    if isinstance(name_or_cls, str):
+        if name_or_cls in _TRAINABLES:
+            return _TRAINABLES[name_or_cls]
+        # RLlib algorithm names (reference: tune resolves agents via
+        # `ray.rllib.agents.registry.get_agent_class`).
+        try:
+            from ..rllib.agents.registry import get_trainer_class
+            return get_trainer_class(name_or_cls)
+        except ValueError:
+            raise ValueError(
+                f"unknown trainable {name_or_cls!r}; registered: "
+                f"{sorted(_TRAINABLES)}")
+    if callable(name_or_cls):
+        return wrap_function(name_or_cls)
+    raise TypeError(f"cannot resolve trainable from {name_or_cls!r}")

@@ -1,0 +1,64 @@
+"""Search-space DSL.
+
+Parity: `python/ray/tune/sample.py` (`sample_from`, `function`) +
+`grid_search` dict convention (`tune/suggest/variant_generator.py`).
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Sequence
+
+
+class sample_from:
+    """Lazy per-trial sampled value: `sample_from(lambda spec: ...)` or a
+    zero-arg callable."""
+
+    def __init__(self, func: Callable):
+        import inspect
+        self.func = func
+        # Determine arity up front — catching TypeError at sample time
+        # would mask errors raised inside the user's function.
+        try:
+            self._takes_spec = len(
+                inspect.signature(func).parameters) >= 1
+        except (TypeError, ValueError):
+            self._takes_spec = True
+
+    def sample(self, spec=None) -> Any:
+        return self.func(spec) if self._takes_spec else self.func()
+
+    def __repr__(self):
+        return f"sample_from({self.func})"
+
+
+def function(func: Callable) -> sample_from:
+    return sample_from(func)
+
+
+def grid_search(values: Sequence) -> dict:
+    """Marks a config key for grid expansion."""
+    return {"grid_search": list(values)}
+
+
+def uniform(low: float, high: float) -> sample_from:
+    return sample_from(lambda spec: random.uniform(low, high))
+
+
+def loguniform(low: float, high: float, base: float = 10.0) -> sample_from:
+    import math
+    lo, hi = math.log(low, base), math.log(high, base)
+    return sample_from(lambda spec: base ** random.uniform(lo, hi))
+
+
+def choice(options: Sequence) -> sample_from:
+    options = list(options)
+    return sample_from(lambda spec: random.choice(options))
+
+
+def randint(low: int, high: int) -> sample_from:
+    return sample_from(lambda spec: random.randint(low, high - 1))
+
+
+def randn(mean: float = 0.0, sd: float = 1.0) -> sample_from:
+    return sample_from(lambda spec: random.gauss(mean, sd))

@@ -1,0 +1,9 @@
+from .trial_scheduler import FIFOScheduler, TrialScheduler
+from .async_hyperband import ASHAScheduler, AsyncHyperBandScheduler
+from .hyperband import HyperBandScheduler
+from .median_stopping_rule import MedianStoppingRule
+from .pbt import PopulationBasedTraining
+
+__all__ = ["ASHAScheduler", "AsyncHyperBandScheduler", "FIFOScheduler",
+           "HyperBandScheduler", "MedianStoppingRule",
+           "PopulationBasedTraining", "TrialScheduler"]

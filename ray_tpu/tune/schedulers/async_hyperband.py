@@ -1,0 +1,98 @@
+"""ASHA: asynchronous successive halving.
+
+Parity: `python/ray/tune/schedulers/async_hyperband.py`
+(`AsyncHyperBandScheduler`, `_Bracket`) — rung milestones at
+grace_period * reduction_factor^k; at each milestone a trial stops unless
+it is in the top 1/reduction_factor of results recorded at that rung.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..trial import Trial
+from .trial_scheduler import FIFOScheduler, TrialScheduler
+
+
+class _Bracket:
+    def __init__(self, min_t: float, max_t: float, reduction_factor: float,
+                 stop_last_trials: bool = True):
+        self.rf = reduction_factor
+        milestones = []
+        t = min_t
+        while t < max_t:
+            milestones.append(t)
+            t *= reduction_factor
+        # rung -> {trial_id: recorded metric}
+        self._rungs = [(m, {}) for m in reversed(milestones)]
+
+    def on_result(self, trial: Trial, cur_iter: float,
+                  cur_rew: float) -> str:
+        action = TrialScheduler.CONTINUE
+        for milestone, recorded in self._rungs:
+            if cur_iter < milestone or trial.trial_id in recorded:
+                continue
+            recorded[trial.trial_id] = cur_rew
+            vals = list(recorded.values())
+            if len(vals) >= self.rf:
+                cutoff = np.nanpercentile(vals, (1 - 1 / self.rf) * 100)
+                if cur_rew < cutoff:
+                    action = TrialScheduler.STOP
+            break
+        return action
+
+    def debug_str(self) -> str:
+        out = []
+        for m, recorded in self._rungs:
+            out.append(f"rung@{m}: n={len(recorded)}")
+        return " | ".join(out)
+
+
+class AsyncHyperBandScheduler(FIFOScheduler):
+    def __init__(self,
+                 time_attr: str = "training_iteration",
+                 metric: str = "episode_reward_mean",
+                 mode: str = "max",
+                 max_t: float = 100,
+                 grace_period: float = 1,
+                 reduction_factor: float = 4,
+                 brackets: int = 1):
+        if mode not in ("max", "min"):
+            raise ValueError("mode must be 'max' or 'min'")
+        self._time_attr = time_attr
+        self._metric = metric
+        self._sign = 1.0 if mode == "max" else -1.0
+        self._max_t = max_t
+        self._brackets = [
+            _Bracket(grace_period * reduction_factor ** s,
+                     max_t, reduction_factor)
+            for s in range(brackets)]
+        self._trial_bracket = {}
+        self._counter = 0
+
+    def on_trial_add(self, trial_runner, trial: Trial):
+        # Round-robin over brackets (the reference samples softmax-
+        # weighted; round-robin has the same expectation for equal sizes).
+        self._trial_bracket[trial.trial_id] = \
+            self._brackets[self._counter % len(self._brackets)]
+        self._counter += 1
+
+    def on_trial_result(self, trial_runner, trial: Trial,
+                        result: dict) -> str:
+        t = result.get(self._time_attr, 0)
+        if self._metric not in result:
+            return TrialScheduler.CONTINUE
+        if t >= self._max_t:
+            return TrialScheduler.STOP
+        return self._trial_bracket[trial.trial_id].on_result(
+            trial, t, self._sign * result[self._metric])
+
+    def on_trial_complete(self, trial_runner, trial: Trial, result: dict):
+        self._trial_bracket.pop(trial.trial_id, None)
+
+    def debug_string(self) -> str:
+        return "AsyncHyperBand: " + " // ".join(
+            b.debug_str() for b in self._brackets)
+
+
+ASHAScheduler = AsyncHyperBandScheduler

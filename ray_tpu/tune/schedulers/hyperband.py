@@ -1,0 +1,144 @@
+"""HyperBand (synchronous brackets).
+
+Parity: `python/ray/tune/schedulers/hyperband.py` — trials are grouped
+into brackets of decreasing size; when every live trial in a bracket has
+reached the bracket's current milestone, the bottom trials halt and the
+bracket continues with the survivors at a longer milestone.
+
+This is the successive-halving core of the reference implementation with
+its bracket-sizing arithmetic (s_max_1 brackets, eta halving); trials that
+finish a band are PAUSEd at milestones and resumed by
+`choose_trial_to_run`.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..trial import Trial
+from .trial_scheduler import FIFOScheduler, TrialScheduler
+
+logger = logging.getLogger(__name__)
+
+
+class _HBBracket:
+    def __init__(self, max_trials: int, init_iters: float, eta: float,
+                 s: int):
+        self.max_trials = max_trials
+        self.cur_iters = init_iters      # milestone for this halving round
+        self.eta = eta
+        self.s = s                       # halvings remaining
+        self.trials: List[Trial] = []
+        self.recorded: Dict[str, float] = {}
+
+    def add(self, trial: Trial) -> bool:
+        if len(self.trials) >= self.max_trials:
+            return False
+        self.trials.append(trial)
+        return True
+
+    def live_trials(self) -> List[Trial]:
+        return [t for t in self.trials if not t.is_finished()]
+
+    def round_done(self) -> bool:
+        return all(t.trial_id in self.recorded
+                   for t in self.live_trials())
+
+    def on_result(self, trial: Trial, it: float, metric: float) -> bool:
+        """Record once the trial reaches the milestone. Returns True if
+        this completes the current round."""
+        if it >= self.cur_iters and trial.trial_id not in self.recorded:
+            self.recorded[trial.trial_id] = metric
+        return self.round_done() and len(self.recorded) > 0
+
+    def successive_halving(self):
+        """Keep the top 1/eta; returns (stop_list, continue_list)."""
+        ranked = sorted(self.live_trials(),
+                        key=lambda t: self.recorded.get(
+                            t.trial_id, float("-inf")),
+                        reverse=True)
+        keep = max(1, int(np.ceil(len(ranked) / self.eta)))
+        survivors, dropped = ranked[:keep], ranked[keep:]
+        self.recorded = {}
+        self.cur_iters *= self.eta
+        self.s -= 1
+        return dropped, survivors
+
+
+class HyperBandScheduler(FIFOScheduler):
+    def __init__(self,
+                 time_attr: str = "training_iteration",
+                 metric: str = "episode_reward_mean",
+                 mode: str = "max",
+                 max_t: float = 81,
+                 reduction_factor: float = 3):
+        self._time_attr = time_attr
+        self._metric = metric
+        self._sign = 1.0 if mode == "max" else -1.0
+        self._max_t = max_t
+        self._eta = reduction_factor
+        # Bracket ladder: s_max+1 brackets, bracket s starts n_s trials
+        # at r_s iterations (Li et al. 2016 / reference hyperband.py).
+        self._s_max = int(np.floor(np.log(max_t) / np.log(self._eta)))
+        self._brackets: List[_HBBracket] = []
+        self._trial_bracket: Dict[str, _HBBracket] = {}
+        self._next_s = self._s_max
+
+    def _make_bracket(self) -> _HBBracket:
+        s = self._next_s
+        self._next_s = self._s_max if self._next_s <= 0 else self._next_s - 1
+        n = int(np.ceil((self._s_max + 1) / (s + 1) * self._eta ** s))
+        r = self._max_t / (self._eta ** s)
+        b = _HBBracket(n, max(1, r), self._eta, s)
+        self._brackets.append(b)
+        return b
+
+    def on_trial_add(self, trial_runner, trial: Trial):
+        for b in self._brackets:
+            if b.add(trial):
+                self._trial_bracket[trial.trial_id] = b
+                return
+        b = self._make_bracket()
+        b.add(trial)
+        self._trial_bracket[trial.trial_id] = b
+
+    def on_trial_result(self, trial_runner, trial: Trial,
+                        result: dict) -> str:
+        if self._metric not in result:
+            return TrialScheduler.CONTINUE
+        it = result.get(self._time_attr, 0)
+        if it >= self._max_t:
+            return TrialScheduler.STOP
+        bracket = self._trial_bracket[trial.trial_id]
+        round_done = bracket.on_result(
+            trial, it, self._sign * result[self._metric])
+        if round_done:
+            dropped, survivors = bracket.successive_halving()
+            for t in dropped:
+                if t is trial:
+                    continue
+                if t.status == Trial.PAUSED:
+                    trial_runner.stop_trial(t)
+                else:
+                    t.status = Trial.TERMINATED if t.is_finished() \
+                        else t.status
+                    trial_runner.request_stop(t)
+            for t in survivors:
+                if t.status == Trial.PAUSED:
+                    t.status = Trial.PENDING  # resume next round
+            if trial in dropped:
+                return TrialScheduler.STOP
+            return TrialScheduler.CONTINUE
+        if trial.trial_id in bracket.recorded:
+            # Reached milestone; wait for bracket peers.
+            return TrialScheduler.PAUSE
+        return TrialScheduler.CONTINUE
+
+    def on_trial_complete(self, trial_runner, trial: Trial, result: dict):
+        self._trial_bracket.pop(trial.trial_id, None)
+
+    def debug_string(self) -> str:
+        return f"HyperBand: {len(self._brackets)} brackets"

@@ -1,0 +1,60 @@
+"""Median stopping rule.
+
+Parity: `python/ray/tune/schedulers/median_stopping_rule.py` — stop a
+trial at time t if its best result so far is worse than the median of all
+other trials' running averages up to t.
+"""
+
+from __future__ import annotations
+
+import collections
+
+import numpy as np
+
+from ..trial import Trial
+from .trial_scheduler import FIFOScheduler, TrialScheduler
+
+
+class MedianStoppingRule(FIFOScheduler):
+    def __init__(self,
+                 time_attr: str = "training_iteration",
+                 metric: str = "episode_reward_mean",
+                 mode: str = "max",
+                 grace_period: float = 10,
+                 min_samples_required: int = 3,
+                 hard_stop: bool = True):
+        self._time_attr = time_attr
+        self._metric = metric
+        self._sign = 1.0 if mode == "max" else -1.0
+        self._grace_period = grace_period
+        self._min_samples = min_samples_required
+        self._hard_stop = hard_stop
+        self._results = collections.defaultdict(list)  # trial -> [(t, m)]
+        self._completed = set()
+
+    def on_trial_result(self, trial_runner, trial: Trial,
+                        result: dict) -> str:
+        if self._metric not in result:
+            return TrialScheduler.CONTINUE
+        t = result.get(self._time_attr, 0)
+        m = self._sign * result[self._metric]
+        self._results[trial.trial_id].append((t, m))
+        if t < self._grace_period:
+            return TrialScheduler.CONTINUE
+        medians = []
+        for other, hist in self._results.items():
+            if other == trial.trial_id:
+                continue
+            vals = [v for (tt, v) in hist if tt <= t]
+            if vals:
+                medians.append(float(np.mean(vals)))
+        if len(medians) < self._min_samples:
+            return TrialScheduler.CONTINUE
+        best = max(v for _, v in self._results[trial.trial_id])
+        if best < float(np.median(medians)):
+            return TrialScheduler.STOP if self._hard_stop \
+                else TrialScheduler.PAUSE
+        return TrialScheduler.CONTINUE
+
+    def on_trial_complete(self, trial_runner, trial: Trial, result: dict):
+        self._completed.add(trial.trial_id)

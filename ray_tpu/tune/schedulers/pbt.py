@@ -1,0 +1,154 @@
+"""Population Based Training.
+
+Parity: `python/ray/tune/schedulers/pbt.py:92` (`PopulationBasedTraining`,
+`explore`:34) — at each perturbation interval, bottom-quantile trials
+clone the state of a top-quantile trial (exploit) and mutate their
+hyperparameters (explore). State moves through in-memory checkpoints.
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+import random
+from typing import Callable, Dict, Optional
+
+from ..checkpoint_manager import Checkpoint
+from ..sample import sample_from
+from ..trial import Trial
+from .trial_scheduler import FIFOScheduler, TrialScheduler
+
+logger = logging.getLogger(__name__)
+
+
+def explore(config: dict, mutations: dict, resample_probability: float,
+            custom_explore_fn: Optional[Callable]) -> dict:
+    """Parity: `pbt.py:34` — per key: resample with prob
+    `resample_probability`, else multiply by 0.8/1.2 (continuous) or step
+    to a neighbor (list)."""
+    new_config = copy.deepcopy(config)
+    for key, distribution in mutations.items():
+        if isinstance(distribution, dict):
+            new_config[key] = explore(
+                config.get(key, {}), distribution, resample_probability,
+                None)
+            continue
+        if isinstance(distribution, list):
+            if random.random() < resample_probability or \
+                    config.get(key) not in distribution:
+                new_config[key] = random.choice(distribution)
+            elif random.random() > 0.5:
+                idx = distribution.index(config[key])
+                new_config[key] = distribution[max(0, idx - 1)]
+            else:
+                idx = distribution.index(config[key])
+                new_config[key] = distribution[
+                    min(len(distribution) - 1, idx + 1)]
+        else:
+            if random.random() < resample_probability:
+                new_config[key] = distribution.sample(None) \
+                    if isinstance(distribution, sample_from) \
+                    else distribution()
+            elif random.random() > 0.5:
+                new_config[key] = config[key] * 1.2
+            else:
+                new_config[key] = config[key] * 0.8
+    if custom_explore_fn:
+        new_config = custom_explore_fn(new_config)
+    return new_config
+
+
+class _PBTTrialState:
+    def __init__(self, trial: Trial):
+        self.orig_tag = trial.experiment_tag
+        self.last_score: Optional[float] = None
+        self.last_checkpoint: Optional[Checkpoint] = None
+        self.last_perturbation_time: float = 0
+
+
+class PopulationBasedTraining(FIFOScheduler):
+    def __init__(self,
+                 time_attr: str = "time_total_s",
+                 metric: str = "episode_reward_mean",
+                 mode: str = "max",
+                 perturbation_interval: float = 60.0,
+                 hyperparam_mutations: Optional[Dict] = None,
+                 quantile_fraction: float = 0.25,
+                 resample_probability: float = 0.25,
+                 custom_explore_fn: Optional[Callable] = None,
+                 log_config: bool = True):
+        if not hyperparam_mutations and not custom_explore_fn:
+            raise ValueError(
+                "You must specify at least one of hyperparam_mutations "
+                "or custom_explore_fn")
+        self._time_attr = time_attr
+        self._metric = metric
+        self._sign = 1.0 if mode == "max" else -1.0
+        self._perturbation_interval = perturbation_interval
+        self._hyperparam_mutations = hyperparam_mutations or {}
+        self._quantile_fraction = quantile_fraction
+        self._resample_probability = resample_probability
+        self._custom_explore_fn = custom_explore_fn
+        self._trial_state: Dict[Trial, _PBTTrialState] = {}
+        self._num_perturbations = 0
+
+    def on_trial_add(self, trial_runner, trial: Trial):
+        self._trial_state[trial] = _PBTTrialState(trial)
+
+    def on_trial_result(self, trial_runner, trial: Trial,
+                        result: dict) -> str:
+        if self._metric not in result or self._time_attr not in result:
+            return TrialScheduler.CONTINUE
+        time_ = result[self._time_attr]
+        state = self._trial_state[trial]
+        if time_ - state.last_perturbation_time < \
+                self._perturbation_interval:
+            return TrialScheduler.CONTINUE
+
+        state.last_score = self._sign * result[self._metric]
+        state.last_perturbation_time = time_
+        lower_quantile, upper_quantile = self._quantiles()
+
+        if trial in upper_quantile:
+            # Top performer: snapshot for exploiters.
+            state.last_checkpoint = trial_runner.trial_executor.save(
+                trial, Checkpoint.MEMORY, result)
+        if trial in lower_quantile and upper_quantile:
+            donor = random.choice(upper_quantile)
+            if self._trial_state[donor].last_checkpoint is not None:
+                self._exploit(trial_runner, trial, donor)
+        return TrialScheduler.CONTINUE
+
+    def _quantiles(self):
+        trials = [t for t, s in self._trial_state.items()
+                  if s.last_score is not None and not t.is_finished()]
+        trials.sort(key=lambda t: self._trial_state[t].last_score)
+        if len(trials) <= 1:
+            return [], []
+        num = max(1, int(len(trials) * self._quantile_fraction))
+        if num >= len(trials):
+            num = len(trials) // 2
+        return trials[:num], trials[-num:]
+
+    def _exploit(self, trial_runner, trial: Trial, donor: Trial):
+        """Clone donor weights, mutate config, restart the trial."""
+        donor_state = self._trial_state[donor]
+        new_config = explore(donor.config, self._hyperparam_mutations,
+                             self._resample_probability,
+                             self._custom_explore_fn)
+        logger.info("PBT: %s exploits %s", trial, donor)
+        self._num_perturbations += 1
+        executor = trial_runner.trial_executor
+        executor.pause_trial(trial)
+        trial.config = new_config
+        trial.experiment_tag = f"{self._trial_state[trial].orig_tag}" \
+            f"@perturbed[{self._num_perturbations}]"
+        trial.restore_blob = donor_state.last_checkpoint.value
+        trial.status = Trial.PENDING  # runner will restart it
+
+    def on_trial_complete(self, trial_runner, trial: Trial, result: dict):
+        self._trial_state.pop(trial, None)
+
+    def debug_string(self) -> str:
+        return f"PopulationBasedTraining: " \
+            f"{self._num_perturbations} perturbs"

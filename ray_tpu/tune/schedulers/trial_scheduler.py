@@ -1,0 +1,49 @@
+"""Scheduler interface + FIFO.
+
+Parity: `python/ray/tune/schedulers/trial_scheduler.py` — schedulers see
+every result and return CONTINUE/PAUSE/STOP; `choose_trial_to_run` picks
+the next trial when resources free up.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..trial import Trial
+
+
+class TrialScheduler:
+    CONTINUE = "CONTINUE"
+    PAUSE = "PAUSE"
+    STOP = "STOP"
+
+    def on_trial_add(self, trial_runner, trial: Trial):
+        pass
+
+    def on_trial_error(self, trial_runner, trial: Trial):
+        pass
+
+    def on_trial_result(self, trial_runner, trial: Trial,
+                        result: dict) -> str:
+        return TrialScheduler.CONTINUE
+
+    def on_trial_complete(self, trial_runner, trial: Trial, result: dict):
+        pass
+
+    def on_trial_remove(self, trial_runner, trial: Trial):
+        pass
+
+    def choose_trial_to_run(self, trial_runner) -> Optional[Trial]:
+        raise NotImplementedError
+
+    def debug_string(self) -> str:
+        return self.__class__.__name__
+
+
+class FIFOScheduler(TrialScheduler):
+    def choose_trial_to_run(self, trial_runner) -> Optional[Trial]:
+        for trial in trial_runner.get_trials():
+            if trial.status in (Trial.PENDING, Trial.PAUSED) and \
+                    trial_runner.has_resources_for_trial(trial):
+                return trial
+        return None

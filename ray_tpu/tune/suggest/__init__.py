@@ -1,0 +1,6 @@
+from .basic_variant import BasicVariantGenerator
+from .search import SearchAlgorithm
+from .variant_generator import generate_variants, format_vars
+
+__all__ = ["BasicVariantGenerator", "SearchAlgorithm",
+           "generate_variants", "format_vars"]

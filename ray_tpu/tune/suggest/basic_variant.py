@@ -1,0 +1,50 @@
+"""Grid/random trial generation.
+
+Parity: `python/ray/tune/suggest/basic_variant.py`
+(`BasicVariantGenerator`) — expands each experiment spec into
+`num_samples` × (grid cartesian product) trials.
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import List
+
+from ..trial import Trial
+from .search import SearchAlgorithm
+from .variant_generator import format_vars, generate_variants
+
+
+class BasicVariantGenerator(SearchAlgorithm):
+    def __init__(self):
+        self._trial_queue: List[Trial] = []
+        self._finished = False
+        self._counter = itertools.count()
+
+    def add_configurations(self, experiments):
+        for exp in experiments:
+            for _ in range(exp.num_samples):
+                for resolved, cfg in generate_variants(exp.config):
+                    i = next(self._counter)
+                    tag = f"{i}" + (f"_{format_vars(resolved)}"
+                                    if resolved else "")
+                    self._trial_queue.append(Trial(
+                        exp.run,
+                        config=cfg,
+                        experiment_tag=tag,
+                        local_dir=exp.local_dir,
+                        stopping_criterion=exp.stop,
+                        checkpoint_freq=exp.checkpoint_freq,
+                        checkpoint_at_end=exp.checkpoint_at_end,
+                        keep_checkpoints_num=exp.keep_checkpoints_num,
+                        checkpoint_score_attr=exp.checkpoint_score_attr,
+                        max_failures=exp.max_failures,
+                        evaluated_params=resolved))
+
+    def next_trials(self) -> List[Trial]:
+        out, self._trial_queue = self._trial_queue, []
+        self._finished = True
+        return out
+
+    def is_finished(self) -> bool:
+        return self._finished

@@ -1,0 +1,34 @@
+"""Search-algorithm interface.
+
+Parity: `python/ray/tune/suggest/search.py` — search algorithms emit
+trials and observe completions. External-library wrappers (Ax, HyperOpt,
+BayesOpt, Nevergrad, SigOpt, skopt, BOHB in the reference) follow this
+interface; those libraries are not vendored here, so the wrappers live
+with their importers and raise ImportError with guidance if the backing
+package is absent.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..trial import Trial
+
+
+class SearchAlgorithm:
+    def add_configurations(self, experiments):
+        raise NotImplementedError
+
+    def next_trials(self) -> List[Trial]:
+        raise NotImplementedError
+
+    def on_trial_result(self, trial_id: str, result: dict):
+        pass
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None,
+                          error: bool = False):
+        pass
+
+    def is_finished(self) -> bool:
+        raise NotImplementedError

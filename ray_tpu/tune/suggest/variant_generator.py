@@ -1,0 +1,79 @@
+"""Grid/random config expansion.
+
+Parity: `python/ray/tune/suggest/variant_generator.py`
+(`generate_variants`, `grid_search` resolution, `format_vars`).
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+from typing import Dict, Iterator, List, Tuple
+
+from ..sample import sample_from
+
+
+def _find_special(spec, path=()):
+    """Yields (path, value) for grid_search dicts and sample_from leaves."""
+    if isinstance(spec, dict):
+        if set(spec.keys()) == {"grid_search"}:
+            yield path, spec
+            return
+        for k, v in spec.items():
+            yield from _find_special(v, path + (k,))
+    elif isinstance(spec, sample_from):
+        yield path, spec
+
+
+def _set_path(spec: dict, path: Tuple, value) -> None:
+    d = spec
+    for k in path[:-1]:
+        d = d[k]
+    d[path[-1]] = value
+
+
+def _get_path(spec: dict, path: Tuple):
+    d = spec
+    for k in path:
+        d = d[k]
+    return d
+
+
+def generate_variants(spec: dict) -> Iterator[Tuple[Dict, dict]]:
+    """Yields (resolved_vars, config) per variant: the cartesian product of
+    all grid axes, with sample_from leaves drawn fresh per variant."""
+    grid_axes: List[Tuple[Tuple, List]] = []
+    samplers: List[Tuple[Tuple, sample_from]] = []
+    for path, v in _find_special(spec):
+        if isinstance(v, sample_from):
+            samplers.append((path, v))
+        else:
+            grid_axes.append((path, v["grid_search"]))
+
+    grids = [vals for _, vals in grid_axes] or [[None]]
+    for combo in itertools.product(*grids):
+        out = copy.deepcopy(spec)
+        resolved = {}
+        if grid_axes:
+            for (path, _), val in zip(grid_axes, combo):
+                _set_path(out, path, val)
+                resolved["/".join(map(str, path))] = val
+        # Re-walk the copied spec for sampler objects (deepcopy copies them).
+        for path, sampler in _find_special(out):
+            if isinstance(sampler, sample_from):
+                val = sampler.sample(out)
+                _set_path(out, path, val)
+                resolved["/".join(map(str, path))] = val
+        yield resolved, out
+
+
+def format_vars(resolved: Dict) -> str:
+    parts = []
+    for k in sorted(resolved):
+        v = resolved[k]
+        name = k.split("/")[-1]
+        if isinstance(v, float):
+            parts.append(f"{name}={v:.5g}")
+        else:
+            parts.append(f"{name}={v}")
+    return ",".join(parts)

@@ -1,0 +1,108 @@
+"""Trial: one parameterized run of a Trainable.
+
+Parity: `python/ray/tune/trial.py` — status lifecycle
+(PENDING/RUNNING/PAUSED/TERMINATED/ERROR), config, resources, checkpoint
+history, last_result.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+import uuid
+from typing import Dict, Optional
+
+from .checkpoint_manager import Checkpoint, CheckpointManager
+
+
+class Trial:
+    PENDING = "PENDING"
+    RUNNING = "RUNNING"
+    PAUSED = "PAUSED"
+    TERMINATED = "TERMINATED"
+    ERROR = "ERROR"
+
+    def __init__(self,
+                 trainable_name: str,
+                 config: Optional[dict] = None,
+                 trial_id: Optional[str] = None,
+                 experiment_tag: str = "",
+                 local_dir: Optional[str] = None,
+                 stopping_criterion: Optional[dict] = None,
+                 checkpoint_freq: int = 0,
+                 checkpoint_at_end: bool = False,
+                 keep_checkpoints_num: Optional[int] = None,
+                 checkpoint_score_attr: str = "training_iteration",
+                 max_failures: int = 0,
+                 evaluated_params: Optional[dict] = None):
+        self.trainable_name = trainable_name
+        self.config = config or {}
+        self.trial_id = trial_id or uuid.uuid4().hex[:8]
+        self.experiment_tag = experiment_tag
+        self.local_dir = local_dir or os.path.expanduser(
+            "~/ray_tpu_results")
+        self.stopping_criterion = stopping_criterion or {}
+        self.checkpoint_freq = checkpoint_freq
+        self.checkpoint_at_end = checkpoint_at_end
+        self.max_failures = max_failures
+        self.evaluated_params = evaluated_params or {}
+
+        self.status = Trial.PENDING
+        self.last_result: Dict = {}
+        self.last_update_time = float("-inf")
+        self.num_failures = 0
+        self.error_msg: Optional[str] = None
+        self.start_time: Optional[float] = None
+        self.logdir: Optional[str] = None
+        self.runner = None       # actor handle while RUNNING
+        self.checkpoint_manager = CheckpointManager(
+            keep_checkpoints_num or float("inf"), checkpoint_score_attr)
+        # In-memory checkpoint used by PAUSE/unpause and PBT exploit.
+        self.restore_blob = None
+
+    # ------------------------------------------------------------------
+    @property
+    def checkpoint(self) -> Optional[Checkpoint]:
+        return self.checkpoint_manager.newest_checkpoint()
+
+    def init_logdir(self):
+        if self.logdir:
+            return self.logdir
+        os.makedirs(self.local_dir, exist_ok=True)
+        name = f"{self.trainable_name}_{self.experiment_tag}" \
+            f"_{self.trial_id}"
+        self.logdir = os.path.join(self.local_dir,
+                                   name.replace("/", "_"))
+        os.makedirs(self.logdir, exist_ok=True)
+        return self.logdir
+
+    def should_stop(self, result: dict) -> bool:
+        """Check user stopping criteria (reference: trial.py
+        `should_stop`)."""
+        if result.get("done"):
+            return True
+        for attr, value in self.stopping_criterion.items():
+            if result.get(attr, float("-inf")) >= value:
+                return True
+        return False
+
+    def should_checkpoint(self) -> bool:
+        if self.checkpoint_freq <= 0:
+            return False
+        it = self.last_result.get("training_iteration", 0)
+        return it % self.checkpoint_freq == 0
+
+    def update_last_result(self, result: dict):
+        self.last_result = result
+        self.last_update_time = time.time()
+
+    def is_finished(self) -> bool:
+        return self.status in (Trial.TERMINATED, Trial.ERROR)
+
+    def __repr__(self):
+        return f"Trial({self.trainable_name}_{self.trial_id}, " \
+            f"{self.status})"
+
+    def __str__(self):
+        tag = f"_{self.experiment_tag}" if self.experiment_tag else ""
+        return f"{self.trainable_name}{tag}_{self.trial_id}"

@@ -1,0 +1,234 @@
+"""TrialRunner: the Tune event loop.
+
+Parity: `python/ray/tune/trial_runner.py` — `step` (:315) starts runnable
+trials, consumes one result, routes it through the scheduler, handles
+checkpoints/failures; experiment-level state checkpointing (:237) enables
+`resume`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+import traceback
+from typing import Dict, List, Optional
+
+from .checkpoint_manager import Checkpoint
+from .schedulers import FIFOScheduler, TrialScheduler
+from .trial import Trial
+from .trial_executor import RayTrialExecutor
+
+logger = logging.getLogger(__name__)
+
+
+class TrialRunner:
+    def __init__(self,
+                 scheduler: Optional[TrialScheduler] = None,
+                 local_checkpoint_dir: Optional[str] = None,
+                 checkpoint_period: float = 10.0,
+                 trial_executor: Optional[RayTrialExecutor] = None):
+        self._scheduler = scheduler or FIFOScheduler()
+        self.trial_executor = trial_executor or RayTrialExecutor()
+        self._trials: List[Trial] = []
+        self._stop_requests = set()
+        self._local_checkpoint_dir = local_checkpoint_dir
+        self._checkpoint_period = checkpoint_period
+        self._last_checkpoint_time = 0.0
+        self._iteration = 0
+        if local_checkpoint_dir:
+            os.makedirs(local_checkpoint_dir, exist_ok=True)
+
+    # ------------------------------------------------------------------
+    def add_trial(self, trial: Trial):
+        self._trials.append(trial)
+        self._scheduler.on_trial_add(self, trial)
+
+    def get_trials(self) -> List[Trial]:
+        return list(self._trials)
+
+    def has_resources_for_trial(self, trial: Trial) -> bool:
+        from .registry import get_trainable_cls
+        cls = get_trainable_cls(trial.trainable_name)
+        res = cls.default_resource_request(trial.config) or {"CPU": 1}
+        return self.trial_executor.has_resources(res)
+
+    def is_finished(self) -> bool:
+        return all(t.is_finished() for t in self._trials)
+
+    def request_stop(self, trial: Trial):
+        """Stop a RUNNING trial when its in-flight result lands (used by
+        synchronous HyperBand halving)."""
+        self._stop_requests.add(trial.trial_id)
+
+    # ------------------------------------------------------------------
+    def step(self):
+        self._iteration += 1
+        # 1. Launch as many runnable trials as resources allow.
+        while True:
+            trial = self._scheduler.choose_trial_to_run(self)
+            if trial is None:
+                break
+            started = self.trial_executor.start_trial(trial)
+            if not started:
+                self._scheduler.on_trial_error(self, trial)
+        # 2. Consume one result.
+        trial = self.trial_executor.get_next_available_trial(timeout=600.0)
+        if trial is None:
+            if not self.is_finished() and \
+                    self.trial_executor.num_running() == 0:
+                raise RuntimeError(
+                    "no trials running and none can be started — "
+                    "resource deadlock? trials: "
+                    + ", ".join(f"{t}:{t.status}" for t in self._trials))
+            return
+        try:
+            result = self.trial_executor.fetch_result(trial)
+        except Exception:
+            self._handle_trial_failure(trial, traceback.format_exc())
+            return
+        self._process_result(trial, result)
+        self._maybe_checkpoint_experiment()
+
+    def _process_result(self, trial: Trial, result: dict):
+        trial.update_last_result(result)
+        forced_stop = trial.trial_id in self._stop_requests
+        if forced_stop:
+            self._stop_requests.discard(trial.trial_id)
+
+        if forced_stop or trial.should_stop(result):
+            self._checkpoint_trial_if_needed(trial, at_end=True)
+            self._scheduler.on_trial_complete(self, trial, result)
+            self.trial_executor.stop_trial(trial)
+            return
+
+        decision = self._scheduler.on_trial_result(self, trial, result)
+        if decision == TrialScheduler.STOP:
+            self._checkpoint_trial_if_needed(trial, at_end=True)
+            self._scheduler.on_trial_complete(self, trial, result)
+            self.trial_executor.stop_trial(trial)
+        elif decision == TrialScheduler.PAUSE:
+            self.trial_executor.pause_trial(trial)
+        else:
+            self._checkpoint_trial_if_needed(trial)
+            if trial.status == Trial.RUNNING:
+                self.trial_executor.continue_training(trial)
+            elif trial.status == Trial.PENDING:
+                # e.g. PBT exploit restarted it; the launch loop in the
+                # next step() will pick it up.
+                pass
+
+    def _checkpoint_trial_if_needed(self, trial: Trial,
+                                    at_end: bool = False):
+        try:
+            if trial.should_checkpoint() or \
+                    (at_end and trial.checkpoint_at_end):
+                if trial.runner is not None:
+                    self.trial_executor.save(trial, Checkpoint.DISK)
+        except Exception:
+            logger.exception("checkpoint of %s failed", trial)
+
+    def _handle_trial_failure(self, trial: Trial, error_msg: str):
+        logger.error("trial %s errored: %s", trial, error_msg)
+        self._scheduler.on_trial_error(self, trial)
+        trial.num_failures += 1
+        if trial.num_failures <= trial.max_failures and trial.checkpoint:
+            # Recover from the last on-disk checkpoint (reference:
+            # trial_runner `max_failures` recovery path).
+            logger.info("restarting %s from checkpoint (failure %d/%d)",
+                        trial, trial.num_failures, trial.max_failures)
+            self.trial_executor.stop_trial(trial, error=True,
+                                           error_msg=error_msg)
+            trial.status = Trial.PENDING
+            trial.restore_blob = None
+            ckpt = trial.checkpoint
+            self.trial_executor.start_trial(trial, checkpoint=ckpt)
+        else:
+            self.trial_executor.stop_trial(trial, error=True,
+                                           error_msg=error_msg)
+
+    # ------------------------------------------------------------------
+    # experiment-level checkpointing (parity: trial_runner.py:237)
+    # ------------------------------------------------------------------
+    def _maybe_checkpoint_experiment(self):
+        if not self._local_checkpoint_dir:
+            return
+        if time.time() - self._last_checkpoint_time < \
+                self._checkpoint_period:
+            return
+        self.checkpoint_experiment()
+
+    def checkpoint_experiment(self):
+        if not self._local_checkpoint_dir:
+            return
+        state = {"iteration": self._iteration,
+                 "timestamp": time.time(),
+                 "trials": [self._trial_record(t) for t in self._trials]}
+        path = os.path.join(self._local_checkpoint_dir,
+                            "experiment_state.json")
+        with open(path + ".tmp", "w") as f:
+            json.dump(state, f, indent=2, default=str)
+        os.replace(path + ".tmp", path)
+        self._last_checkpoint_time = time.time()
+
+    @staticmethod
+    def _trial_record(t: Trial) -> dict:
+        ckpt = t.checkpoint
+        return {
+            "trial_id": t.trial_id,
+            "trainable_name": t.trainable_name,
+            "config": t.config,
+            "status": t.status,
+            "experiment_tag": t.experiment_tag,
+            "last_result": {
+                k: v for k, v in t.last_result.items()
+                if isinstance(v, (int, float, str, bool))},
+            "logdir": t.logdir,
+            "checkpoint_path": ckpt.value
+            if ckpt and ckpt.storage == Checkpoint.DISK else None,
+        }
+
+    @classmethod
+    def restore_experiment_trials(cls, local_checkpoint_dir: str,
+                                  stopping_criterion: dict,
+                                  checkpoint_freq: int,
+                                  checkpoint_at_end: bool,
+                                  max_failures: int) -> List[Trial]:
+        """Rebuild Trial objects from a previous experiment state; finished
+        trials come back TERMINATED, others PENDING (restored from their
+        newest disk checkpoint if any)."""
+        path = os.path.join(local_checkpoint_dir, "experiment_state.json")
+        with open(path) as f:
+            state = json.load(f)
+        trials = []
+        for rec in state["trials"]:
+            t = Trial(rec["trainable_name"], config=rec["config"],
+                      trial_id=rec["trial_id"],
+                      experiment_tag=rec["experiment_tag"],
+                      local_dir=local_checkpoint_dir,
+                      stopping_criterion=stopping_criterion,
+                      checkpoint_freq=checkpoint_freq,
+                      checkpoint_at_end=checkpoint_at_end,
+                      max_failures=max_failures)
+            t.logdir = rec["logdir"]
+            t.last_result = rec["last_result"]
+            if rec["status"] == Trial.TERMINATED:
+                t.status = Trial.TERMINATED
+            else:
+                t.status = Trial.PENDING
+                if rec["checkpoint_path"] and \
+                        os.path.exists(rec["checkpoint_path"]):
+                    t.checkpoint_manager.on_checkpoint(Checkpoint(
+                        Checkpoint.DISK, rec["checkpoint_path"],
+                        t.last_result))
+            trials.append(t)
+        return trials
+
+    def debug_string(self) -> str:
+        by_status: Dict[str, int] = {}
+        for t in self._trials:
+            by_status[t.status] = by_status.get(t.status, 0) + 1
+        return (f"TrialRunner: {len(self._trials)} trials "
+                + ", ".join(f"{k}={v}" for k, v in sorted(by_status.items()))
+                + " | " + self._scheduler.debug_string())

@@ -1,0 +1,113 @@
+"""tune.run / run_experiments: the experiment drivers.
+
+Parity: `python/ray/tune/tune.py` — `run` (:68) builds trials from the
+spec, drives a TrialRunner to completion, returns an ExperimentAnalysis;
+`run_experiments` (:353) runs a dict of named experiment specs.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import List, Optional, Union
+
+import ray_tpu
+
+from .analysis import ExperimentAnalysis
+from .experiment import Experiment
+from .schedulers import FIFOScheduler
+from .suggest.basic_variant import BasicVariantGenerator
+from .trial import Trial
+from .trial_runner import TrialRunner
+
+logger = logging.getLogger(__name__)
+
+
+def run(run_or_experiment,
+        name: Optional[str] = None,
+        stop: Optional[dict] = None,
+        config: Optional[dict] = None,
+        num_samples: int = 1,
+        scheduler=None,
+        local_dir: Optional[str] = None,
+        checkpoint_freq: int = 0,
+        checkpoint_at_end: bool = False,
+        keep_checkpoints_num: Optional[int] = None,
+        checkpoint_score_attr: str = "training_iteration",
+        max_failures: int = 0,
+        resume: bool = False,
+        verbose: int = 1,
+        raise_on_failed_trial: bool = True) -> ExperimentAnalysis:
+    if isinstance(run_or_experiment, Experiment):
+        experiment = run_or_experiment
+    else:
+        experiment = Experiment(
+            name, run_or_experiment, stop=stop, config=config,
+            num_samples=num_samples, local_dir=local_dir,
+            checkpoint_freq=checkpoint_freq,
+            checkpoint_at_end=checkpoint_at_end,
+            keep_checkpoints_num=keep_checkpoints_num,
+            checkpoint_score_attr=checkpoint_score_attr,
+            max_failures=max_failures)
+    return run_experiments(
+        [experiment], scheduler=scheduler, resume=resume, verbose=verbose,
+        raise_on_failed_trial=raise_on_failed_trial)
+
+
+def run_experiments(experiments,
+                    scheduler=None,
+                    resume: bool = False,
+                    verbose: int = 1,
+                    raise_on_failed_trial: bool = True
+                    ) -> ExperimentAnalysis:
+    if isinstance(experiments, dict):
+        experiments = [Experiment.from_json(name, spec)
+                       for name, spec in experiments.items()]
+    elif isinstance(experiments, Experiment):
+        experiments = [experiments]
+
+    if not ray_tpu.is_initialized():
+        ray_tpu.init()
+
+    scheduler = scheduler or FIFOScheduler()
+    runner = TrialRunner(
+        scheduler=scheduler,
+        local_checkpoint_dir=experiments[0].local_dir)
+
+    trials: List[Trial] = []
+    if resume:
+        try:
+            trials = TrialRunner.restore_experiment_trials(
+                experiments[0].local_dir,
+                experiments[0].stop,
+                experiments[0].checkpoint_freq,
+                experiments[0].checkpoint_at_end,
+                experiments[0].max_failures)
+            logger.info("resumed %d trials", len(trials))
+        except FileNotFoundError:
+            logger.warning("resume requested but no experiment state "
+                           "found; starting fresh")
+    if not trials:
+        search = BasicVariantGenerator()
+        search.add_configurations(experiments)
+        trials = search.next_trials()
+    for t in trials:
+        runner.add_trial(t)
+
+    last_debug = 0.0
+    while not runner.is_finished():
+        runner.step()
+        if verbose and time.time() - last_debug > 5:
+            logger.info(runner.debug_string())
+            last_debug = time.time()
+    runner.checkpoint_experiment()
+
+    errored = [t for t in runner.get_trials()
+               if t.status == Trial.ERROR]
+    if errored:
+        msg = f"{len(errored)} trial(s) failed: " + ", ".join(
+            str(t) for t in errored)
+        if raise_on_failed_trial:
+            raise RuntimeError(msg)
+        logger.error(msg)
+    return ExperimentAnalysis(runner.get_trials())

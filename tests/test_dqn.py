@@ -147,7 +147,9 @@ def dqn_config(**overrides):
         "num_envs_per_worker": 1,
         "exploration_timesteps": 4000,
         "exploration_final_eps": 0.02,
-        "target_network_update_freq": 300,
+        # trained-steps keyed (reference semantics): ~every 62 train
+        # batches at batch 64 == every ~250 sampled steps here.
+        "target_network_update_freq": 4000,
         "timesteps_per_iteration": 500,
         "lr": 1e-3,
         "hiddens": [64],
