@@ -1,0 +1,107 @@
+"""Multi-device learner tests on the virtual 8-CPU-device mesh.
+
+Parity: the reference exercises its multi-GPU learner via
+`rllib/tests/test_optimizers.py` (LocalMultiGPUOptimizer with num_gpus>1 on
+fake devices). Here the learner program is jitted over a
+`jax.sharding.Mesh` of num_tpus_for_learner devices (conftest.py forces 8
+virtual CPU devices), so XLA inserts the gradient all-reduce.
+"""
+
+import numpy as np
+import pytest
+
+
+class TestMultiDeviceLearner:
+    def test_ppo_mesh4_trains(self):
+        from ray_tpu.rllib.agents.ppo import PPOTrainer
+        t = PPOTrainer(config={
+            "env": "CartPole-v0",
+            "num_workers": 0,
+            "num_tpus_for_learner": 4,
+            "train_batch_size": 256,
+            "sgd_minibatch_size": 64,
+            "num_sgd_iter": 3,
+            "rollout_fragment_length": 64,
+            "num_envs_per_worker": 2,
+            "model": {"fcnet_hiddens": [32, 32]},
+            "seed": 0,
+        })
+        r1 = t.train()
+        r2 = t.train()
+        assert np.isfinite(r2["info"]["learner"]["total_loss"])
+        # Params stay replicated across the mesh: a fresh single-device
+        # policy loaded with the trained weights must act identically.
+        from ray_tpu.rllib.agents.ppo import PPOTrainer as P2
+        w = t.get_policy().get_weights()
+        t1 = P2(config={
+            "env": "CartPole-v0", "num_workers": 0,
+            "train_batch_size": 256, "sgd_minibatch_size": 64,
+            "rollout_fragment_length": 64,
+            "model": {"fcnet_hiddens": [32, 32]}, "seed": 0,
+        })
+        t1.get_policy().set_weights(w)
+        obs = np.array([[0.01, 0.0, 0.02, 0.0]] * 4, np.float32)
+        a_mesh, _, _ = t.get_policy().compute_actions(obs, explore=False)
+        a_one, _, _ = t1.get_policy().compute_actions(obs, explore=False)
+        np.testing.assert_array_equal(np.asarray(a_mesh), np.asarray(a_one))
+        t1.stop()
+        t.stop()
+
+    def test_impala_mesh4_trains(self, ray_start):
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        cls = get_trainer_class("IMPALA")
+        t = cls(config={
+            "env": "CartPole-v0",
+            "num_workers": 1,
+            "num_tpus_for_learner": 4,
+            "rollout_fragment_length": 64,
+            "train_batch_size": 128,
+            "model": {"fcnet_hiddens": [32, 32]},
+            "seed": 0,
+        })
+        for _ in range(3):
+            r = t.train()
+        assert r["timesteps_total"] > 0
+        learner = r["info"]["learner"]
+        assert np.isfinite(learner["total_loss"])
+        t.stop()
+
+    def test_mesh4_matches_mesh1_loss(self):
+        """Same batch, same seed: the 4-device sharded update must compute
+        the same loss as the single-device program (all-reduce correctness).
+        """
+        from ray_tpu.rllib.agents.ppo.ppo import DEFAULT_CONFIG, PPOJaxPolicy
+        from ray_tpu.rllib.env.spaces import Box, Discrete
+        from ray_tpu.parallel import mesh as mesh_lib
+        import __graft_entry__ as ge
+        import jax
+
+        num_actions = 4
+        obs_shape = (8,)
+        batch = ge._synthetic_ppo_batch(64, obs_shape, num_actions)
+
+        def make_policy(n_dev):
+            cfg = dict(DEFAULT_CONFIG)
+            cfg.update({
+                "model": {"fcnet_hiddens": [16, 16]},
+                "num_sgd_iter": 1,
+                "sgd_minibatch_size": 64,
+                "train_batch_size": 64,
+                "seed": 0,
+            })
+            if n_dev > 1:
+                cfg["_mesh"] = mesh_lib.make_mesh(
+                    devices=jax.devices()[:n_dev], axis_names=("dp",))
+            return PPOJaxPolicy(
+                Box(low=-np.inf, high=np.inf, shape=obs_shape,
+                    dtype=np.float32),
+                Discrete(num_actions), cfg)
+
+        p1 = make_policy(1)
+        p4 = make_policy(4)
+        # Align initial weights.
+        p4.set_weights(p1.get_weights())
+        s1 = p1.sgd_learn(batch, num_sgd_iter=1, minibatch_size=64)
+        s4 = p4.sgd_learn(batch, num_sgd_iter=1, minibatch_size=64)
+        np.testing.assert_allclose(
+            s1["total_loss"], s4["total_loss"], rtol=2e-4)
