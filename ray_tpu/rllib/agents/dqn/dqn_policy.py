@@ -175,7 +175,16 @@ class DQNPolicy(JaxPolicy):
                 batch[sb.ACTIONS].astype(jnp.int32), self.num_actions)
             q_sel = jnp.sum(q_t * one_hot, axis=-1)
             q_tp1, _ = self.apply(target_params, batch[sb.NEW_OBS])
-            best = jnp.max(q_tp1, axis=-1)
+            if cfg["double_q"]:
+                # Match dqn_loss: online argmax, target gather — so Ape-X
+                # worker-side initial priorities use the learner's TD
+                # definition (reference computes them from the loss graph).
+                q_tp1_online, _ = self.apply(params, batch[sb.NEW_OBS])
+                best_idx = jnp.argmax(q_tp1_online, axis=-1)
+                best = jnp.take_along_axis(
+                    q_tp1, best_idx[:, None], axis=-1)[:, 0]
+            else:
+                best = jnp.max(q_tp1, axis=-1)
             gamma_n = self.config["gamma"] ** self.config["n_step"]
             target = batch[sb.REWARDS] + gamma_n * best \
                 * (1.0 - batch[sb.DONES])

@@ -116,19 +116,8 @@ class HyperBandScheduler(FIFOScheduler):
         round_done = bracket.on_result(
             trial, it, self._sign * result[self._metric])
         if round_done:
-            dropped, survivors = bracket.successive_halving()
-            for t in dropped:
-                if t is trial:
-                    continue
-                if t.status == Trial.PAUSED:
-                    trial_runner.stop_trial(t)
-                else:
-                    t.status = Trial.TERMINATED if t.is_finished() \
-                        else t.status
-                    trial_runner.request_stop(t)
-            for t in survivors:
-                if t.status == Trial.PAUSED:
-                    t.status = Trial.PENDING  # resume next round
+            dropped = self._do_halving(trial_runner, bracket,
+                                       current=trial)
             if trial in dropped:
                 return TrialScheduler.STOP
             return TrialScheduler.CONTINUE
@@ -137,8 +126,62 @@ class HyperBandScheduler(FIFOScheduler):
             return TrialScheduler.PAUSE
         return TrialScheduler.CONTINUE
 
+    def _do_halving(self, trial_runner, bracket: _HBBracket,
+                    current: Optional[Trial]):
+        """Run successive halving on a completed round: stop the dropped
+        trials (the executor owns stop_trial — reference hyperband.py calls
+        `trial_runner._get_trial_executor().stop_trial`), release the
+        survivors to run to the next milestone."""
+        dropped, survivors = bracket.successive_halving()
+        for t in dropped:
+            if t is current:
+                continue  # caller returns STOP for it
+            self._trial_bracket.pop(t.trial_id, None)
+            if t.status in (Trial.PAUSED, Trial.PENDING):
+                t.restore_blob = None  # free the paused state blob
+                trial_runner.trial_executor.stop_trial(t)
+            else:
+                trial_runner.request_stop(t)
+        for t in survivors:
+            if t.status == Trial.PAUSED:
+                t.status = Trial.PENDING  # resume next round
+        return dropped
+
+    def choose_trial_to_run(self, trial_runner) -> Optional[Trial]:
+        """Unlike FIFO, never restart a trial that is waiting at its
+        bracket's current milestone — synchronous halving means it must
+        sit until the round completes."""
+        for t in trial_runner.get_trials():
+            if t.status not in (Trial.PENDING, Trial.PAUSED):
+                continue
+            b = self._trial_bracket.get(t.trial_id)
+            if b is not None and t.trial_id in b.recorded:
+                continue
+            if trial_runner.has_resources_for_trial(t):
+                return t
+        return None
+
     def on_trial_complete(self, trial_runner, trial: Trial, result: dict):
-        self._trial_bracket.pop(trial.trial_id, None)
+        self._cleanup(trial_runner, trial)
+
+    def on_trial_error(self, trial_runner, trial: Trial):
+        self._cleanup(trial_runner, trial)
+
+    def _cleanup(self, trial_runner, trial: Trial):
+        """Drop the trial from its bracket; if its exit completes the
+        round (peers already recorded and paused), trigger the halving so
+        they don't wait forever."""
+        b = self._trial_bracket.pop(trial.trial_id, None)
+        if b is None:
+            return
+        b.recorded.pop(trial.trial_id, None)
+        # The exiting trial may still read RUNNING here (the runner sets
+        # TERMINATED after this hook) — remove it from the bracket so
+        # round_done()/ranking never count it.
+        if trial in b.trials:
+            b.trials.remove(trial)
+        if b.recorded and b.round_done():
+            self._do_halving(trial_runner, b, current=None)
 
     def debug_string(self) -> str:
         return f"HyperBand: {len(self._brackets)} brackets"

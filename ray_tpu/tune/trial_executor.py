@@ -54,13 +54,16 @@ class RayTrialExecutor:
                 config=trial.config, logger_creator=logger_creator)
             trial.runner = runner
             self._trial_actor[trial] = runner
-            restore_blob = None
+            if checkpoint is None and trial.restore_blob is None:
+                # Experiment resume / recovery: fall back to the trial's
+                # newest disk checkpoint (reference ray_trial_executor
+                # start_trial consults trial.checkpoint).
+                checkpoint = trial.checkpoint
             if checkpoint is not None:
-                restore_blob = checkpoint.value
+                self.restore(trial, checkpoint)
             elif trial.restore_blob is not None:
-                restore_blob = trial.restore_blob
-            if restore_blob is not None:
-                ray_tpu.get(runner.restore_from_object.remote(restore_blob))
+                ray_tpu.get(
+                    runner.restore_from_object.remote(trial.restore_blob))
                 trial.restore_blob = None  # consumed
             trial.status = Trial.RUNNING
             trial.start_time = time.time()

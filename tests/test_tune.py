@@ -263,3 +263,116 @@ class TestRLlibTuneIntegration:
         t = analysis.trials[0]
         assert t.last_result["training_iteration"] == 2
         assert "episode_reward_mean" in t.last_result
+
+
+class TestHyperBand:
+    def test_hyperband_end_to_end(self, ray_start, tmp_path):
+        """Synchronous halving drops bottom trials at milestones and the
+        winner survives to max_t."""
+        import json as _json
+        from ray_tpu import tune
+        from ray_tpu.tune.schedulers import HyperBandScheduler
+        from ray_tpu.tune.trial import Trial
+
+        class Linear(tune.Trainable):
+            """score = x * iter; pausable (HyperBand milestones move
+            trials through memory checkpoints)."""
+
+            def _setup(self, config):
+                self.i = 0
+
+            def _train(self):
+                self.i += 1
+                return {"score": self.config["x"] * self.i}
+
+            def _save(self, d):
+                p = os.path.join(d, "s.json")
+                with open(p, "w") as f:
+                    _json.dump({"i": self.i}, f)
+                return p
+
+            def _restore(self, path):
+                with open(path) as f:
+                    self.i = _json.load(f)["i"]
+
+        sched = HyperBandScheduler(
+            metric="score", mode="max", max_t=9, reduction_factor=3)
+        analysis = tune.run(
+            Linear, name="hb",
+            config={"x": tune.grid_search([1.0, 2.0, 3.0, 4.0])},
+            scheduler=sched,
+            stop={"training_iteration": 9},
+            local_dir=str(tmp_path),
+            raise_on_failed_trial=False)
+        assert len(analysis.trials) == 4
+        assert all(t.status == Trial.TERMINATED for t in analysis.trials)
+        best = analysis.get_best_trial(metric="score", mode="max")
+        assert best.config["x"] == 4.0
+        # Halving actually cut someone short of max_t.
+        iters = sorted(t.last_result.get("training_iteration", 0)
+                       for t in analysis.trials)
+        assert iters[0] < 9
+        assert iters[-1] == 9
+
+    def test_resume_restores_from_checkpoint(self, ray_start, tmp_path):
+        """An interrupted experiment resumes trials from their newest disk
+        checkpoint instead of restarting from scratch."""
+        import json as _json
+        from ray_tpu import tune
+        from ray_tpu.tune.trial import Trial
+
+        marker_dir = str(tmp_path / "marks")
+        os.makedirs(marker_dir, exist_ok=True)
+
+        class Counting(tune.Trainable):
+            def _setup(self, config):
+                self.x = 0
+                self._mark = os.path.join(
+                    config["marker_dir"], "calls.txt")
+
+            def _train(self):
+                self.x += 1
+                with open(self._mark, "a") as f:
+                    f.write(f"{self.x}\n")
+                return {"score": self.x}
+
+            def _save(self, d):
+                p = os.path.join(d, "state.json")
+                with open(p, "w") as f:
+                    _json.dump({"x": self.x}, f)
+                return p
+
+            def _restore(self, path):
+                with open(path) as f:
+                    self.x = _json.load(f)["x"]
+
+        analysis = tune.run(
+            Counting, name="resume_ckpt",
+            config={"marker_dir": marker_dir},
+            stop={"training_iteration": 3},
+            checkpoint_freq=1, checkpoint_at_end=True,
+            local_dir=str(tmp_path))
+        exp_dir = os.path.dirname(analysis.trials[0].logdir)
+        state_path = os.path.join(exp_dir, "experiment_state.json")
+        # Simulate an interrupted run: mark the trial unfinished.
+        with open(state_path) as f:
+            state = _json.load(f)
+        for rec in state["trials"]:
+            rec["status"] = Trial.RUNNING
+        with open(state_path, "w") as f:
+            _json.dump(state, f)
+
+        analysis2 = tune.run(
+            Counting, name="resume_ckpt",
+            config={"marker_dir": marker_dir},
+            stop={"training_iteration": 5},
+            checkpoint_freq=1,
+            local_dir=str(tmp_path), resume=True)
+        t = analysis2.trials[0]
+        assert t.status == Trial.TERMINATED
+        assert t.last_result["training_iteration"] == 5
+        assert t.last_result["score"] == 5
+        # 3 calls in run 1 + 2 after restore-at-3 (not 5) in run 2.
+        with open(os.path.join(marker_dir, "calls.txt")) as f:
+            calls = [int(x) for x in f.read().split()]
+        assert calls == [1, 2, 3, 4, 5], calls
