@@ -1,24 +1,26 @@
-"""Headline benchmark: RL learner throughput (timesteps/s/chip).
+"""Headline benchmark: end-to-end IMPALA throughput (timesteps/s/chip).
 
 Mirrors the reference's north-star number — RLlib IMPALA learner
 throughput, ~30k transitions/s on 2×V100 = 15k/s per accelerator
-(`doc/source/rllib-algorithms.rst:90-91`, BASELINE.md). Here the learner
-step is the TPU-native PPO/IMPALA update: one donated-buffer XLA program
-doing the full minibatch-SGD phase on an Atari-shaped batch
-(84x84x4 uint8 frames, Nature CNN), on however many local chips exist.
+(`doc/source/rllib-algorithms.rst:90-91`, BASELINE.md).
 
-Measured in steady state with the batch staged on-device, i.e. the
-throughput of the compiled learner program itself — in production the
-host→device feed is double-buffered behind the update (SURVEY.md §7.4#4),
-and on this harness the chip sits behind a ~100 MB/s tunnel that would
-otherwise swamp the measurement with an artifact of the test rig.
+Two numbers are reported in ONE json line:
+- `value` (headline, tracked vs the 15k/s/chip anchor): END-TO-END
+  pipeline throughput — CPU rollout workers → AsyncSamplesOptimizer →
+  TPU learner, driven through the real IMPALATrainer at the
+  `synthetic-atari-impala.yaml` configuration (scaled to this host's
+  core count). Counted as timesteps TRAINED per second per chip.
+- `kernel_per_chip`: steady-state throughput of the compiled learner
+  update program alone (batch staged on-device) — the ceiling the
+  pipeline is chasing.
 
-Prints ONE json line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE json line: {"metric", "value", "unit", "vs_baseline", ...}.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import time
 
 import numpy as np
@@ -26,7 +28,8 @@ import numpy as np
 BASELINE_PER_CHIP = 15000.0  # transitions/s/chip (2xV100 -> 30k total)
 
 
-def main():
+def bench_kernel(n_dev: int) -> float:
+    """Learner-kernel-only throughput (timesteps/s/chip)."""
     import jax
     from __graft_entry__ import _synthetic_ppo_batch
     from ray_tpu.parallel import mesh as mesh_lib
@@ -34,7 +37,6 @@ def main():
     from ray_tpu.rllib.env.spaces import Box, Discrete
 
     devices = jax.devices()
-    n_dev = len(devices)
     mesh = mesh_lib.make_mesh(devices=devices, axis_names=("dp",))
 
     num_actions = 6
@@ -52,14 +54,12 @@ def main():
     batch = _synthetic_ppo_batch(batch_size, obs_shape, num_actions,
                                  obs_dtype=np.uint8)
 
-    # Stage the batch on device and grab the compiled update program.
     dev_batch = policy._device_batch(batch)
     num_mb = batch_size // minibatch
     update = policy._make_sgd_fn(num_sgd_iter, num_mb, minibatch)
     rng = jax.random.PRNGKey(0)
 
     params, opt_state = policy.params, policy.opt_state
-    # Warmup / compile.
     for _ in range(3):
         params, opt_state, stats = update(params, opt_state, dev_batch, rng,
                                           policy.loss_state)
@@ -72,14 +72,61 @@ def main():
                                           policy.loss_state)
     jax.block_until_ready(params)
     dt = time.perf_counter() - t0
+    return iters * batch_size / dt / n_dev
 
-    ts_per_s = iters * batch_size / dt
-    per_chip = ts_per_s / n_dev
+
+def bench_pipeline(n_dev: int):
+    """End-to-end IMPALA: rollout workers -> async optimizer -> learner,
+    through the real trainer (the `rllib train` code path), at the
+    `synthetic-atari-impala.yaml` shape scaled to this host. The learner
+    mesh spans all `n_dev` local chips, so the per-chip division is
+    consistent with the kernel number."""
+    import ray_tpu
+    from ray_tpu.rllib.agents.registry import get_trainer_class
+
+    ncpu = os.cpu_count() or 1
+    num_workers = max(1, min(8, ncpu - 1))
+    ray_tpu.init(num_cpus=max(num_workers, 2))
+    trainer_cls = get_trainer_class("IMPALA")
+    trainer = trainer_cls(config={
+        "env": "SyntheticAtari-v0",
+        "num_workers": num_workers,
+        "num_envs_per_worker": 4,
+        "rollout_fragment_length": 50,
+        "train_batch_size": 500,
+        "num_sgd_iter": 1,
+        "lr": 6e-4,
+        "num_tpus_for_learner": n_dev,
+        "min_iter_time_s": 5,
+        "seed": 0,
+    })
+    trainer.train()  # warmup: compiles learner + inference programs
+    opt = trainer.optimizer
+    t0 = time.perf_counter()
+    trained0 = opt.num_steps_trained
+    deadline = t0 + 30
+    while time.perf_counter() < deadline:
+        trainer.train()
+    dt = time.perf_counter() - t0
+    trained = opt.num_steps_trained - trained0
+    trainer.stop()
+    ray_tpu.shutdown()
+    return trained / dt / n_dev, num_workers
+
+
+def main():
+    import jax
+    n_dev = len(jax.devices())
+    kernel = bench_kernel(n_dev)
+    pipeline, num_workers = bench_pipeline(n_dev)
     print(json.dumps({
-        "metric": "learner_throughput_per_chip",
-        "value": round(per_chip, 1),
+        "metric": "impala_end_to_end_throughput_per_chip",
+        "value": round(pipeline, 1),
         "unit": "timesteps/s/chip",
-        "vs_baseline": round(per_chip / BASELINE_PER_CHIP, 3),
+        "vs_baseline": round(pipeline / BASELINE_PER_CHIP, 3),
+        "kernel_per_chip": round(kernel, 1),
+        "kernel_vs_baseline": round(kernel / BASELINE_PER_CHIP, 3),
+        "num_rollout_workers": num_workers,
     }))
 
 

@@ -2,11 +2,18 @@
 
 Transport parity note: the reference's control plane is gRPC + asio Unix
 sockets (`src/ray/rpc/grpc_server.cc`, `src/ray/common/client_connection.cc`).
-Here every process exposes one Unix-domain-socket server; peers hold direct
-persistent connections (the "direct call" topology of the reference's
+Here every process exposes one socket server; peers hold direct persistent
+connections (the "direct call" topology of the reference's
 `direct_task_transport.h` / `direct_actor_transport.h`). Messages are Python
 dicts with a `kind` field, serialized with pickle protocol 5. Requests carry
 a `seq`; replies echo it as `reply_to`.
+
+Addressing: a plain filesystem path binds an AF_UNIX socket (intra-node);
+`tcp://host:port` binds AF_INET (the inter-node plane, standing in for the
+reference's gRPC services — `node_manager.proto:78`, `core_worker.proto:150`).
+Both address forms speak the identical framed protocol, so a worker talks to
+a same-node peer over Unix sockets and a remote-node peer over TCP with no
+code change above this module.
 """
 
 from __future__ import annotations
@@ -23,6 +30,28 @@ logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
 PICKLE_PROTOCOL = 5
+
+TCP_PREFIX = "tcp://"
+
+
+def is_tcp(addr: str) -> bool:
+    return addr.startswith(TCP_PREFIX)
+
+
+def parse_tcp(addr: str):
+    hostport = addr[len(TCP_PREFIX):]
+    host, _, port = hostport.rpartition(":")
+    return host or "127.0.0.1", int(port)
+
+
+def _make_client_socket(addr: str):
+    """Returns (unconnected socket, connect target) for `addr`."""
+    if is_tcp(addr):
+        host, port = parse_tcp(addr)
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock, (host, port)
+    return socket.socket(socket.AF_UNIX, socket.SOCK_STREAM), addr
 
 
 class ConnectionClosed(Exception):
@@ -185,11 +214,20 @@ class Server:
         self.handler = handler
         self.on_connect = on_connect
         self.on_close = on_close
-        if os.path.exists(path):
-            os.unlink(path)
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._sock.bind(path)
+        if is_tcp(path):
+            host, port = parse_tcp(path)
+            self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self._sock.setsockopt(
+                socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self._sock.bind((host, port))
+            # Resolve an ephemeral port request (port 0) to the real one.
+            self.path = f"{TCP_PREFIX}{host}:{self._sock.getsockname()[1]}"
+        else:
+            if os.path.exists(path):
+                os.unlink(path)
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._sock.bind(path)
         self._sock.listen(256)
         self.connections: Dict[str, Connection] = {}
         self._lock = threading.Lock()
@@ -208,6 +246,8 @@ class Server:
                 target=self._handshake, args=(sock,), daemon=True).start()
 
     def _handshake(self, sock: socket.socket):
+        if sock.family == socket.AF_INET:
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         try:
             hello = pickle.loads(_recv_msg(sock))
             assert hello.get("kind") == "hello", hello
@@ -238,7 +278,7 @@ class Server:
             conns = list(self.connections.values())
         for c in conns:
             c.close()
-        if os.path.exists(self.path):
+        if not is_tcp(self.path) and os.path.exists(self.path):
             try:
                 os.unlink(self.path)
             except OSError:
@@ -249,10 +289,11 @@ def connect(path: str, my_addr: str, handler: Callable,
             hello_extra: Optional[dict] = None,
             on_close: Optional[Callable] = None,
             timeout: float = 30.0) -> Connection:
-    """Dial a peer's Unix-socket server and perform the hello handshake."""
-    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    """Dial a peer's server (Unix path or tcp://host:port) and perform
+    the hello handshake."""
+    sock, target = _make_client_socket(path)
     sock.settimeout(timeout)
-    sock.connect(path)
+    sock.connect(target)
     sock.settimeout(None)
     hello = {"kind": "hello", "addr": my_addr}
     if hello_extra:

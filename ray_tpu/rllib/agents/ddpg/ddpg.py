@@ -35,6 +35,7 @@ DEFAULT_CONFIG = with_common_config({
     "ou_theta": 0.15,
     "ou_sigma": 0.2,
     "pure_exploration_steps": 1000,
+    "no_done_at_end": False,
     "buffer_size": 50000,
     "prioritized_replay": True,
     "prioritized_replay_alpha": 0.6,

@@ -103,17 +103,28 @@ def make_ppo_optimizer(workers, config):
 
 def update_kl(trainer, fetches):
     """Adaptive KL coefficient (reference: `ppo.py` update_kl /
-    `ppo_policy.py` KLCoeffMixin)."""
-    policy = trainer.get_policy()
-    if "kl" not in fetches or not policy.loss_state:
-        return
-    kl, target = fetches["kl"], trainer.config["kl_target"]
-    coeff = float(policy.loss_state["kl_coeff"])
-    if kl > 2.0 * target:
-        coeff *= 1.5
-    elif kl < 0.5 * target:
-        coeff *= 0.5
-    policy.update_loss_state(kl_coeff=coeff)
+    `ppo_policy.py` KLCoeffMixin). Handles both single-policy fetches
+    and multi-agent {policy_id: fetches} dicts."""
+    def _update_one(policy, pf):
+        if "kl" not in pf or not policy.loss_state:
+            return
+        kl = pf["kl"]
+        target = policy.config.get("kl_target",
+                                   trainer.config["kl_target"])
+        coeff = float(policy.loss_state["kl_coeff"])
+        if kl > 2.0 * target:
+            coeff *= 1.5
+        elif kl < 0.5 * target:
+            coeff *= 0.5
+        policy.update_loss_state(kl_coeff=coeff)
+
+    worker = trainer.workers.local_worker
+    if worker.policy_map is not None:
+        for pid, pf in fetches.items():
+            if isinstance(pf, dict):
+                _update_one(worker.policy_map[pid], pf)
+    else:
+        _update_one(trainer.get_policy(), fetches)
 
 
 def validate_config(config):

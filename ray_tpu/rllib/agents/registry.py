@@ -41,6 +41,26 @@ def _apex():
     return ApexTrainer
 
 
+def _ddpg():
+    from .ddpg import DDPGTrainer
+    return DDPGTrainer
+
+
+def _td3():
+    from .ddpg import TD3Trainer
+    return TD3Trainer
+
+
+def _apex_ddpg():
+    from .ddpg import ApexDDPGTrainer
+    return ApexDDPGTrainer
+
+
+def _sac():
+    from .sac import SACTrainer
+    return SACTrainer
+
+
 ALGORITHMS = {
     "PG": _pg,
     "PPO": _ppo,
@@ -50,6 +70,10 @@ ALGORITHMS = {
     "DQN": _dqn,
     "SimpleQ": _simple_q,
     "APEX": _apex,
+    "DDPG": _ddpg,
+    "TD3": _td3,
+    "APEX_DDPG": _apex_ddpg,
+    "SAC": _sac,
 }
 
 

@@ -1,0 +1,4 @@
+from .sac import DEFAULT_CONFIG, SACTrainer
+from .sac_policy import SACPolicy
+
+__all__ = ["DEFAULT_CONFIG", "SACPolicy", "SACTrainer"]

@@ -1,13 +1,15 @@
-"""DDPG/TD3 policy: deterministic actor + Q critic(s) + target nets.
+"""SAC policy: squashed-Gaussian actor, twin-Q critics, learned alpha.
 
-Parity: `rllib/agents/ddpg/ddpg_policy.py` — actor/critic towers with
-target networks, n-step returns, prioritized-replay TD feedback, TD3
-extensions (twin Q, delayed policy updates, smoothed target actions;
-reference `agents/ddpg/td3.py`).
+Parity: `rllib/agents/sac/sac_policy.py` — soft actor-critic with
+clipped double-Q targets, reparameterized tanh-Gaussian actor, and
+automatic entropy-temperature tuning against a target entropy
+(reference `sac_policy.py` builds three TF towers + three optimizers).
 
-TPU re-architecture: critic update, (delayed) actor update, and polyak
-target sync compile into ONE donated-buffer XLA program; exploration
-noise is host-side numpy on top of the jitted deterministic forward.
+TPU re-architecture: the critic step, actor step, alpha step, and the
+polyak target sync all compile into ONE donated-buffer XLA program per
+`learn_with_td` call, sharded batch-parallel over the policy mesh.
+Action sampling is a second jitted program driven by a folded-in PRNG
+key, so rollouts never leave XLA either.
 """
 
 from __future__ import annotations
@@ -21,70 +23,61 @@ import numpy as np
 import optax
 
 from ....models import catalog
-from ....models.networks import ContinuousQNetwork, DeterministicActor
+from ....models.networks import ContinuousQNetwork, StochasticActor
 from ....parallel import mesh as mesh_lib
 from ... import sample_batch as sb
 from ...policy.policy import Policy
 from ...utils.config import deep_merge
 from ..dqn.dqn_policy import adjust_nstep, huber_loss
 
-DDPG_POLICY_DEFAULTS = {
-    "twin_q": False,
-    "policy_delay": 1,
-    "smooth_target_policy": False,
-    "target_noise": 0.2,
-    "target_noise_clip": 0.5,
-    "actor_hiddens": [400, 300],
+SAC_POLICY_DEFAULTS = {
+    "twin_q": True,
+    "actor_hiddens": [256, 256],
     "actor_hidden_activation": "relu",
-    "critic_hiddens": [400, 300],
+    "critic_hiddens": [256, 256],
     "critic_hidden_activation": "relu",
     "n_step": 1,
     "gamma": 0.99,
-    "actor_lr": 1e-4,
-    "critic_lr": 1e-3,
-    "tau": 0.002,
-    "l2_reg": 1e-6,
-    "grad_clip": None,
+    "actor_lr": 3e-4,
+    "critic_lr": 3e-4,
+    "alpha_lr": 3e-4,
+    "initial_alpha": 1.0,
+    # "auto" => -|A| (the SAC paper's heuristic), else a float.
+    "target_entropy": "auto",
+    "tau": 5e-3,
     "use_huber": False,
     "huber_threshold": 1.0,
-    # Exploration (gaussian; reference default is OU noise — see
-    # `exploration_ou` to enable the OU process)
-    "exploration_noise_sigma": 0.1,
-    "exploration_ou": False,
-    "ou_theta": 0.15,
-    "ou_sigma": 0.2,
+    "grad_clip": None,
     "pure_exploration_steps": 1000,
-    # Parity: reference SAC/DDPG `no_done_at_end` — treat episode-end
-    # dones as non-terminal in the TD target (time-limit truncation).
+    # Treat episode-end dones as non-terminal for the TD target
+    # (parity: reference SAC config `no_done_at_end` — correct for
+    # time-limit-truncated envs like Pendulum).
     "no_done_at_end": False,
     "use_gae": False,
     "worker_side_prioritization": False,
 }
 
-
-def _postprocess_nstep(policy, batch, other_agent_batches=None,
-                       episode=None):
-    adjust_nstep(policy.config["n_step"], policy.config["gamma"], batch)
-    if policy.config.get("no_done_at_end"):
-        batch[sb.DONES] = np.zeros_like(np.asarray(batch[sb.DONES]))
-    if policy.config.get("worker_side_prioritization"):
-        batch["td_error"] = policy.compute_td_error(batch)
-    return batch
+LOG_STD_MIN = -20.0
+LOG_STD_MAX = 2.0
 
 
-class DDPGPolicy(Policy):
+class SACPolicy(Policy):
     def __init__(self, observation_space, action_space, config):
-        cfg = deep_merge(deep_merge({}, DDPG_POLICY_DEFAULTS), config)
+        cfg = deep_merge(deep_merge({}, SAC_POLICY_DEFAULTS), config)
         super().__init__(observation_space, action_space, cfg)
         if not hasattr(action_space, "low"):
-            raise ValueError("DDPG requires a Box action space")
+            raise ValueError("SAC requires a Box action space")
         self.preprocessor = catalog.get_preprocessor(observation_space)
         self.action_dim = int(np.prod(action_space.shape))
         self.low = float(np.min(action_space.low))
         self.high = float(np.max(action_space.high))
+        if cfg["target_entropy"] == "auto":
+            self.target_entropy = -float(self.action_dim)
+        else:
+            self.target_entropy = float(cfg["target_entropy"])
 
-        self.actor = DeterministicActor(
-            action_dim=self.action_dim, low=self.low, high=self.high,
+        self.actor = StochasticActor(
+            action_dim=self.action_dim,
             hiddens=tuple(cfg["actor_hiddens"]),
             activation=cfg["actor_hidden_activation"])
         self.critic = ContinuousQNetwork(
@@ -104,15 +97,16 @@ class DDPGPolicy(Policy):
             "actor": self.actor.init(self._next_rng(), dummy_obs),
             "critic": self.critic.init(self._next_rng(), dummy_obs,
                                        dummy_act),
+            "log_alpha": jnp.log(jnp.float32(cfg["initial_alpha"])),
         }
         self.actor_tx = optax.adam(cfg["actor_lr"])
-        critic_tx = optax.adam(cfg["critic_lr"])
-        if cfg["l2_reg"]:
-            critic_tx = optax.chain(
-                optax.add_decayed_weights(cfg["l2_reg"]), critic_tx)
-        self.critic_tx = critic_tx
-        opt_state = {"actor": self.actor_tx.init(params["actor"]),
-                     "critic": self.critic_tx.init(params["critic"])}
+        self.critic_tx = optax.adam(cfg["critic_lr"])
+        self.alpha_tx = optax.adam(cfg["alpha_lr"])
+        opt_state = {
+            "actor": self.actor_tx.init(params["actor"]),
+            "critic": self.critic_tx.init(params["critic"]),
+            "alpha": self.alpha_tx.init(params["log_alpha"]),
+        }
 
         self.mesh = cfg.get("_mesh") or mesh_lib.make_mesh(num_devices=1)
         self._repl = mesh_lib.replicated(self.mesh)
@@ -120,13 +114,13 @@ class DDPGPolicy(Policy):
         self.params = mesh_lib.put_replicated(params, self.mesh)
         self.opt_state = mesh_lib.put_replicated(opt_state, self.mesh)
         self._tree_copy = jax.jit(lambda p: jax.tree.map(jnp.copy, p))
-        self.target_params = self._tree_copy(self.params)
+        # Only the critic has a target copy (SAC keeps online actor).
+        self.target_params = self._tree_copy(
+            {"critic": self.params["critic"]})
 
         self._update_lock = threading.Lock()
         self._update_count = 0
         self.global_timestep = 0
-        # Host-side OU state per recent batch shape.
-        self._ou_state = None
         self._build_fns(cfg)
 
     # ------------------------------------------------------------------
@@ -134,26 +128,44 @@ class DDPGPolicy(Policy):
         self._rng_counter += 1
         return jax.random.fold_in(self._host_rng, self._rng_counter)
 
+    def _dist(self, aparams, obs):
+        out = self.actor.apply(aparams, obs)
+        mean, log_std = jnp.split(out, 2, axis=-1)
+        log_std = jnp.clip(log_std, LOG_STD_MIN, LOG_STD_MAX)
+        return mean, log_std
+
+    def _sample_action(self, aparams, obs, rng):
+        """Reparameterized tanh-Gaussian sample -> (action, log_prob)."""
+        mean, log_std = self._dist(aparams, obs)
+        std = jnp.exp(log_std)
+        eps = jax.random.normal(rng, mean.shape)
+        pre = mean + std * eps
+        tanh = jnp.tanh(pre)
+        # log det of the tanh + affine-rescale jacobian
+        logp = jnp.sum(
+            -0.5 * (eps ** 2 + 2.0 * log_std + jnp.log(2.0 * jnp.pi))
+            - 2.0 * (jnp.log(2.0) - pre - jax.nn.softplus(-2.0 * pre)),
+            axis=-1) - self.action_dim * jnp.log((self.high - self.low) / 2.0)
+        action = self.low + (tanh + 1.0) * (self.high - self.low) / 2.0
+        return action, logp
+
     def _build_fns(self, cfg):
         gamma_n = cfg["gamma"] ** cfg["n_step"]
         use_huber = cfg["use_huber"]
         delta = cfg["huber_threshold"]
         twin = cfg["twin_q"]
-        smooth = cfg["smooth_target_policy"]
+        tau = cfg["tau"]
+        target_entropy = self.target_entropy
 
-        def critic_loss(cparams, target_params, batch, rng):
-            a_next = self.actor.apply(target_params["actor"],
-                                      batch[sb.NEW_OBS])
-            if smooth:
-                noise = jnp.clip(
-                    cfg["target_noise"] * jax.random.normal(
-                        rng, a_next.shape),
-                    -cfg["target_noise_clip"], cfg["target_noise_clip"])
-                a_next = jnp.clip(a_next + noise, self.low, self.high)
+        def critic_loss(cparams, params, target_params, batch, rng):
+            a_next, logp_next = self._sample_action(
+                params["actor"], batch[sb.NEW_OBS], rng)
             q1t, q2t = self.critic.apply(target_params["critic"],
                                          batch[sb.NEW_OBS], a_next)
             q_next = jnp.minimum(q1t, q2t) if twin else q1t
-            target = batch[sb.REWARDS] + gamma_n * q_next \
+            alpha = jnp.exp(params["log_alpha"])
+            soft_next = q_next - alpha * logp_next
+            target = batch[sb.REWARDS] + gamma_n * soft_next \
                 * (1.0 - batch[sb.DONES])
             target = jax.lax.stop_gradient(target)
             actions = batch[sb.ACTIONS]
@@ -172,67 +184,81 @@ class DDPGPolicy(Policy):
                 loss = loss + jnp.mean(w * err2)
             return loss, (td, jnp.mean(q1))
 
-        def actor_loss(aparams, cparams, batch):
-            a = self.actor.apply(aparams, batch[sb.OBS])
-            q1, _ = self.critic.apply(cparams, batch[sb.OBS], a)
-            return -jnp.mean(q1)
+        def actor_loss(aparams, params, batch, rng):
+            a, logp = self._sample_action(aparams, batch[sb.OBS], rng)
+            q1, q2 = self.critic.apply(params["critic"], batch[sb.OBS], a)
+            q = jnp.minimum(q1, q2) if twin else q1
+            alpha = jax.lax.stop_gradient(jnp.exp(params["log_alpha"]))
+            return jnp.mean(alpha * logp - q), jnp.mean(logp)
 
-        tau = cfg["tau"]
+        def alpha_loss(log_alpha, mean_logp):
+            return -log_alpha * jax.lax.stop_gradient(
+                mean_logp + target_entropy)
 
         def polyak(target, online):
             return jax.tree.map(
                 lambda t, o: (1.0 - tau) * t + tau * o, target, online)
 
-        def update(params, target_params, opt_state, batch, rng,
-                   do_policy_update: bool):
+        def update(params, target_params, opt_state, batch, rng):
+            rng_c, rng_a = jax.random.split(rng)
             (closs, (td, mean_q)), cgrads = jax.value_and_grad(
                 critic_loss, has_aux=True)(
-                    params["critic"], target_params, batch, rng)
+                    params["critic"], params, target_params, batch, rng_c)
             cupd, new_copt = self.critic_tx.update(
                 cgrads, opt_state["critic"], params["critic"])
             new_critic = optax.apply_updates(params["critic"], cupd)
+            p_after_c = dict(params, critic=new_critic)
 
-            if do_policy_update:
-                aloss, agrads = jax.value_and_grad(actor_loss)(
-                    params["actor"], new_critic, batch)
-                aupd, new_aopt = self.actor_tx.update(
-                    agrads, opt_state["actor"], params["actor"])
-                new_actor = optax.apply_updates(params["actor"], aupd)
-                new_params = {"actor": new_actor, "critic": new_critic}
-                new_targets = polyak(target_params, new_params)
-            else:
-                aloss = jnp.float32(0.0)
-                new_aopt = opt_state["actor"]
-                new_params = {"actor": params["actor"],
-                              "critic": new_critic}
-                new_targets = target_params
-            new_opt = {"actor": new_aopt, "critic": new_copt}
+            (aloss, mean_logp), agrads = jax.value_and_grad(
+                actor_loss, has_aux=True)(
+                    params["actor"], p_after_c, batch, rng_a)
+            aupd, new_aopt = self.actor_tx.update(
+                agrads, opt_state["actor"], params["actor"])
+            new_actor = optax.apply_updates(params["actor"], aupd)
+
+            lloss, lgrad = jax.value_and_grad(alpha_loss)(
+                params["log_alpha"], mean_logp)
+            lupd, new_lopt = self.alpha_tx.update(
+                lgrad, opt_state["alpha"], params["log_alpha"])
+            new_log_alpha = optax.apply_updates(params["log_alpha"], lupd)
+
+            new_params = {"actor": new_actor, "critic": new_critic,
+                          "log_alpha": new_log_alpha}
+            new_targets = polyak(target_params, {"critic": new_critic})
+            new_opt = {"actor": new_aopt, "critic": new_copt,
+                       "alpha": new_lopt}
             stats = {"critic_loss": closs, "actor_loss": aloss,
-                     "mean_q": mean_q, "td_error": td}
+                     "alpha_loss": lloss,
+                     "alpha": jnp.exp(new_log_alpha),
+                     "mean_q": mean_q, "entropy": -mean_logp,
+                     "td_error": td}
             return new_params, new_targets, new_opt, stats
 
-        # Two compiled variants (static do_policy_update).
-        self._update_fns = {
-            flag: jax.jit(
-                lambda p, t, o, b, r, _f=flag: update(p, t, o, b, r, _f),
-                donate_argnums=(0, 1, 2),
-                in_shardings=(self._repl, self._repl, self._repl,
-                              self._bshard, self._repl),
-                out_shardings=(self._repl, self._repl, self._repl,
-                               self._repl))
-            for flag in (True, False)}
+        self._update_fn = jax.jit(
+            update, donate_argnums=(0, 1, 2),
+            in_shardings=(self._repl, self._repl, self._repl,
+                          self._bshard, self._repl),
+            out_shardings=(self._repl, self._repl, self._repl,
+                           self._repl))
 
-        self._actor_fn = jax.jit(
-            lambda params, obs: self.actor.apply(params["actor"], obs))
+        def act_fn(params, obs, rng, deterministic):
+            mean, _ = self._dist(params["actor"], obs)
+            det = self.low + (jnp.tanh(mean) + 1.0) \
+                * (self.high - self.low) / 2.0
+            stoch, _ = self._sample_action(params["actor"], obs, rng)
+            return jnp.where(deterministic, det, stoch)
 
-        def td_fn(params, target_params, batch):
-            a_next = self.actor.apply(target_params["actor"],
-                                      batch[sb.NEW_OBS])
+        self._act_fn = jax.jit(act_fn)
+
+        def td_fn(params, target_params, batch, rng):
+            a_next, logp_next = self._sample_action(
+                params["actor"], batch[sb.NEW_OBS], rng)
             q1t, q2t = self.critic.apply(target_params["critic"],
                                          batch[sb.NEW_OBS], a_next)
             q_next = jnp.minimum(q1t, q2t) if twin else q1t
-            target = batch[sb.REWARDS] + gamma_n * q_next \
-                * (1.0 - batch[sb.DONES])
+            alpha = jnp.exp(params["log_alpha"])
+            target = batch[sb.REWARDS] + gamma_n \
+                * (q_next - alpha * logp_next) * (1.0 - batch[sb.DONES])
             actions = batch[sb.ACTIONS]
             if actions.ndim == 1:
                 actions = actions[:, None]
@@ -243,41 +269,29 @@ class DDPGPolicy(Policy):
         self._td_fn = jax.jit(td_fn)
 
     # ------------------------------------------------------------------
-    # rollout inference: jitted deterministic forward + host-side noise
-    # ------------------------------------------------------------------
     def compute_actions(self, obs_batch, state_batches=None, explore=True,
                         prev_action_batch=None, prev_reward_batch=None):
         obs = jnp.asarray(obs_batch)
-        with self._update_lock:
-            actions = np.asarray(self._actor_fn(self.params, obs))
-        if explore:
-            cfg = self.config
-            if self.global_timestep < cfg["pure_exploration_steps"]:
-                actions = self._np_rng.uniform(
-                    self.low, self.high, actions.shape).astype(np.float32)
-            elif cfg["exploration_ou"]:
-                if self._ou_state is None or \
-                        self._ou_state.shape != actions.shape:
-                    self._ou_state = np.zeros_like(actions)
-                self._ou_state += (
-                    -cfg["ou_theta"] * self._ou_state
-                    + cfg["ou_sigma"] * self._np_rng.standard_normal(
-                        actions.shape).astype(np.float32))
-                actions = actions + self._ou_state \
-                    * (self.high - self.low) / 2.0
-            else:
-                actions = actions + self._np_rng.normal(
-                    0.0, cfg["exploration_noise_sigma"],
-                    actions.shape).astype(np.float32) \
-                    * (self.high - self.low) / 2.0
-            actions = np.clip(actions, self.low, self.high)
+        if explore and self.global_timestep \
+                < self.config["pure_exploration_steps"]:
+            actions = self._np_rng.uniform(
+                self.low, self.high,
+                (len(obs_batch), self.action_dim)).astype(np.float32)
+        else:
+            with self._update_lock:
+                actions = np.asarray(self._act_fn(
+                    self.params, obs, self._next_rng(), not explore))
         self.global_timestep += len(actions)
         return actions, [], {}
 
     def postprocess_trajectory(self, batch, other_agent_batches=None,
                                episode=None):
-        return _postprocess_nstep(self, batch, other_agent_batches,
-                                  episode)
+        adjust_nstep(self.config["n_step"], self.config["gamma"], batch)
+        if self.config.get("no_done_at_end"):
+            batch[sb.DONES] = np.zeros_like(np.asarray(batch[sb.DONES]))
+        if self.config.get("worker_side_prioritization"):
+            batch["td_error"] = self.compute_td_error(batch)
+        return batch
 
     # ------------------------------------------------------------------
     def _device_batch(self, batch) -> dict:
@@ -294,13 +308,10 @@ class DDPGPolicy(Policy):
     def learn_with_td(self, batch):
         dev = self._device_batch(batch)
         self._update_count += 1
-        do_policy = (self._update_count
-                     % self.config["policy_delay"]) == 0
         with self._update_lock:
             self.params, self.target_params, self.opt_state, stats = \
-                self._update_fns[do_policy](
-                    self.params, self.target_params, self.opt_state, dev,
-                    self._next_rng())
+                self._update_fn(self.params, self.target_params,
+                                self.opt_state, dev, self._next_rng())
         stats = dict(stats)
         td = np.asarray(stats.pop("td_error"))
         return {k: float(v) for k, v in stats.items()}, np.abs(td)
@@ -312,14 +323,14 @@ class DDPGPolicy(Policy):
     def compute_td_error(self, batch) -> np.ndarray:
         dev = self._device_batch(batch)
         with self._update_lock:
-            td = self._td_fn(self.params, self.target_params, dev)
+            td = self._td_fn(self.params, self.target_params, dev,
+                             self._next_rng())
         return np.asarray(td)
 
     def update_target(self) -> None:
-        """Hard target sync (reference exposes it; soft tau updates run
-        inside the jitted step)."""
         with self._update_lock:
-            self.target_params = self._tree_copy(self.params)
+            self.target_params = self._tree_copy(
+                {"critic": self.params["critic"]})
 
     # ------------------------------------------------------------------
     def get_weights(self):
@@ -332,11 +343,14 @@ class DDPGPolicy(Policy):
         with self._update_lock:
             if isinstance(weights, dict) and "online" in weights:
                 self.params = mesh_lib.put_replicated(
-                    weights["online"], self.mesh)
+                    jax.tree.map(jnp.asarray, weights["online"]),
+                    self.mesh)
                 self.target_params = mesh_lib.put_replicated(
-                    weights["target"], self.mesh)
+                    jax.tree.map(jnp.asarray, weights["target"]),
+                    self.mesh)
             else:
-                self.params = mesh_lib.put_replicated(weights, self.mesh)
+                self.params = mesh_lib.put_replicated(
+                    jax.tree.map(jnp.asarray, weights), self.mesh)
 
     def get_state(self):
         with self._update_lock:

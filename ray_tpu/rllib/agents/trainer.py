@@ -54,9 +54,11 @@ COMMON_CONFIG = {
     "num_tpus_for_learner": 0,
     # === Fault tolerance (parity: trainer.py:425) ===
     "ignore_worker_failures": False,
-    # === Evaluation ===
+    # === Evaluation (parity: trainer.py:560 `_evaluate`) ===
     "evaluation_interval": None,
     "evaluation_num_episodes": 10,
+    # Config overrides applied to the evaluation worker's policy/env.
+    "evaluation_config": {},
     # === Reporting ===
     "min_iter_time_s": 0,
     "timesteps_per_iteration": 0,
@@ -126,7 +128,9 @@ class Trainer(Trainable):
         `Trainer.train`, trainer.py:425)."""
         for attempt in range(3):
             try:
-                return self._train_inner()
+                result = self._train_inner()
+                self._maybe_evaluate(result)
+                return result
             except RayError as e:
                 if not self.config.get("ignore_worker_failures"):
                     raise
@@ -167,6 +171,54 @@ class Trainer(Trainable):
         return result
 
     # ------------------------------------------------------------------
+    def _maybe_evaluate(self, result: dict):
+        interval = self.config.get("evaluation_interval")
+        if not interval:
+            return
+        self._iters_since_eval = getattr(self, "_iters_since_eval", 0) + 1
+        if self._iters_since_eval < interval:
+            return
+        self._iters_since_eval = 0
+        result["evaluation"] = self._evaluate()
+
+    def _evaluate(self) -> dict:
+        """Run `evaluation_num_episodes` deterministic episodes on a
+        dedicated eval worker (parity: `trainer.py:560` — a separate
+        evaluation WorkerSet synced to the learner weights, with
+        `evaluation_config` overrides applied)."""
+        from ..evaluation.rollout_worker import RolloutWorker
+        if getattr(self, "_eval_worker", None) is None:
+            cfg = deep_merge(deep_merge({}, self.config),
+                             self.config.get("evaluation_config") or {})
+            cfg.pop("_mesh", None)
+            self._eval_worker = RolloutWorker(
+                self.env_creator, type(self.get_policy()), cfg,
+                num_envs=cfg.get("num_envs_per_worker", 1),
+                rollout_fragment_length=cfg.get(
+                    "rollout_fragment_length", 100),
+                worker_index=0,
+                seed=cfg.get("seed"),
+                observation_filter=cfg.get(
+                    "observation_filter", "NoFilter"),
+                explore=False,
+                env_config=cfg.get("env_config"),
+                horizon=cfg.get("horizon"))
+        # local_worker.get_weights() returns {policy_id: weights} in
+        # multi-agent mode and a bare tree otherwise — symmetric with
+        # the eval worker's set_weights.
+        self._eval_worker.set_weights(
+            self.workers.local_worker.get_weights())
+        if hasattr(self.workers.local_worker, "get_filters"):
+            self._eval_worker.sync_filters(
+                self.workers.local_worker.get_filters())
+        n = self.config.get("evaluation_num_episodes", 10)
+        self._eval_worker.get_metrics()  # drain stale episodes
+        episodes = []
+        while len(episodes) < n:
+            self._eval_worker.sample()
+            episodes.extend(self._eval_worker.get_metrics())
+        return summarize_episodes(episodes)
+
     def get_policy(self):
         return self.workers.local_worker.policy
 
@@ -177,8 +229,9 @@ class Trainer(Trainable):
 
     # ------------------------------------------------------------------
     def __getstate__(self) -> dict:
-        """Checkpointable state (parity: `trainer.py:857`)."""
-        state = {"policy": self.get_policy().get_state(),
+        """Checkpointable state (parity: `trainer.py:857`). In
+        multi-agent mode `policy` holds {policy_id: state}."""
+        state = {"policy": self.workers.local_worker.get_policy_state(),
                  "config_overrides": {}}
         if hasattr(self.workers.local_worker, "obs_filter"):
             state["obs_filter"] = \
@@ -189,7 +242,7 @@ class Trainer(Trainable):
         return state
 
     def __setstate__(self, state: dict):
-        self.get_policy().set_state(state["policy"])
+        self.workers.local_worker.set_policy_state(state["policy"])
         if "obs_filter" in state:
             self.workers.local_worker.sync_filters(state["obs_filter"])
         opt = getattr(self, "optimizer", None)
@@ -208,6 +261,8 @@ class Trainer(Trainable):
             self.__setstate__(pickle.load(f))
 
     def _stop(self):
+        if getattr(self, "_eval_worker", None) is not None:
+            self._eval_worker.stop()
         if hasattr(self, "workers"):
             self.workers.stop()
         opt = getattr(self, "optimizer", None)

@@ -1,0 +1,63 @@
+"""MultiAgentEnv: dict-keyed multi-agent environment API.
+
+Parity: `rllib/env/multi_agent_env.py` — reset() returns
+{agent_id: obs}; step(action_dict) returns (obs, rewards, dones, infos)
+dicts keyed by agent id, with dones["__all__"] marking episode end.
+Agents may appear/disappear between steps; only agents present in the
+returned obs dict are polled for actions next step.
+
+`MultiAgentCartPole` mirrors the reference's multi-agent regression env
+(`rllib/examples/multiagent_cartpole.py`): N independent CartPole agents
+stepping simultaneously in one env.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+from .env import CartPole
+
+
+class MultiAgentEnv:
+    def reset(self) -> Dict:
+        raise NotImplementedError
+
+    def step(self, action_dict: Dict) -> Tuple[Dict, Dict, Dict, Dict]:
+        raise NotImplementedError
+
+    def close(self):
+        pass
+
+    def seed(self, seed=None):
+        pass
+
+
+class MultiAgentCartPole(MultiAgentEnv):
+    """`num_agents` independent CartPoles advancing in lockstep; the
+    episode ends when every agent's pole has fallen (done agents stop
+    being polled)."""
+
+    def __init__(self, num_agents: int = 2, max_steps: int = 200):
+        self.agents = [CartPole(max_steps=max_steps)
+                       for _ in range(num_agents)]
+        self.observation_space = self.agents[0].observation_space
+        self.action_space = self.agents[0].action_space
+        self._done = [False] * num_agents
+
+    def seed(self, seed=None):
+        for i, a in enumerate(self.agents):
+            if hasattr(a, "seed"):
+                a.seed(None if seed is None else seed + i)
+
+    def reset(self):
+        self._done = [False] * len(self.agents)
+        return {i: a.reset() for i, a in enumerate(self.agents)}
+
+    def step(self, action_dict):
+        obs, rew, done, info = {}, {}, {}, {}
+        for i, action in action_dict.items():
+            o, r, d, inf = self.agents[i].step(action)
+            self._done[i] = d
+            obs[i], rew[i], done[i], info[i] = o, r, d, inf
+        done["__all__"] = all(self._done)
+        return obs, rew, done, info

@@ -40,3 +40,12 @@ register_env("SyntheticAtari-v0",
              lambda cfg: SyntheticAtari(
                  episode_len=cfg.get("episode_len", 1000),
                  num_actions=cfg.get("num_actions", 6)))
+
+
+def _multiagent_cartpole(cfg):
+    from .multi_agent_env import MultiAgentCartPole
+    return MultiAgentCartPole(num_agents=cfg.get("num_agents", 2),
+                              max_steps=cfg.get("max_steps", 200))
+
+
+register_env("MultiAgentCartPole-v0", _multiagent_cartpole)

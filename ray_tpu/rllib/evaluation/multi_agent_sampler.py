@@ -1,0 +1,187 @@
+"""Multi-agent sampling loop.
+
+Parity: the multi-agent path of `rllib/evaluation/sampler.py:226`
+(`_env_runner` over a `MultiAgentEnv` via `BaseEnv`) — per-agent episode
+builders, a policy map with `policy_mapping_fn`, per-policy batched
+action computation, and `MultiAgentBatch` output.
+
+TPU shape: each policy's `compute_actions` is ONE jitted call per env
+step covering every (env, agent) slot mapped to that policy — agents are
+batched by policy, not looped.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from .. import sample_batch as sb
+from ..sample_batch import MultiAgentBatch, SampleBatch
+from .sampler import RolloutMetrics, _EpisodeBuilder
+
+
+class MultiAgentSyncSampler:
+    """Steps `num_envs` MultiAgentEnv copies for T steps per sample().
+
+    `postprocess_fn(policy_id, batch, last_obs or None)` runs per agent
+    trajectory at episode end (last_obs=None) or fragment truncation.
+    """
+
+    def __init__(self, env_creator: Callable, policy_map: Dict,
+                 policy_mapping_fn: Callable,
+                 rollout_fragment_length: int,
+                 num_envs: int = 1,
+                 postprocess_fn: Optional[Callable] = None,
+                 explore: bool = True,
+                 horizon: Optional[int] = None,
+                 env_config: Optional[dict] = None,
+                 seed: Optional[int] = None):
+        self.envs = [env_creator(dict(env_config or {}))
+                     for _ in range(num_envs)]
+        if seed is not None:
+            for i, e in enumerate(self.envs):
+                e.seed(seed + i * 100)
+        self.policy_map = policy_map
+        self.mapping_fn = policy_mapping_fn
+        self.T = rollout_fragment_length
+        self.postprocess_fn = postprocess_fn
+        self.explore = explore
+        self.horizon = horizon
+        self._eps_counter = 0
+        # per-env state
+        self._obs = [e.reset() for e in self.envs]
+        self._ep_steps = [0] * num_envs
+        self._ep_reward = [0.0] * num_envs
+        self._eps_ids = []
+        for _ in range(num_envs):
+            self._eps_counter += 1
+            self._eps_ids.append(self._eps_counter)
+        # (env_idx, agent_id) -> builder
+        self._builders: Dict = {}
+        self._agent_policy: Dict = {}  # (env_idx, agent_id) -> policy_id
+        self.metrics: List[RolloutMetrics] = []
+
+    # ------------------------------------------------------------------
+    def _policy_for(self, env_idx, agent_id) -> str:
+        key = (env_idx, agent_id)
+        if key not in self._agent_policy:
+            self._agent_policy[key] = self.mapping_fn(agent_id)
+        return self._agent_policy[key]
+
+    def _builder_for(self, env_idx, agent_id) -> _EpisodeBuilder:
+        key = (env_idx, agent_id)
+        if key not in self._builders:
+            self._builders[key] = _EpisodeBuilder(self._eps_ids[env_idx])
+        return self._builders[key]
+
+    def _preprocess(self, pid, obs):
+        pre = getattr(self.policy_map[pid], "preprocessor", None)
+        if pre is not None and not getattr(pre, "is_identity", False):
+            return pre.transform(obs)
+        return obs
+
+    def _flush(self, env_idx, agent_id, chunks, bootstrap_obs=None):
+        """Postprocess + emit one agent trajectory chunk."""
+        key = (env_idx, agent_id)
+        b = self._builders.pop(key, None)
+        if b is None or b.count() == 0:
+            return
+        pid = self._policy_for(env_idx, agent_id)
+        chunk = b.build()
+        if self.postprocess_fn is not None:
+            chunk = self.postprocess_fn(pid, chunk, bootstrap_obs)
+        chunks[pid].append(chunk)
+
+    # ------------------------------------------------------------------
+    def sample(self) -> MultiAgentBatch:
+        chunks: Dict[str, List[SampleBatch]] = collections.defaultdict(list)
+        env_steps = 0
+        for _ in range(self.T):
+            # Group live (env, agent) slots by policy.
+            by_policy: Dict[str, List] = collections.defaultdict(list)
+            for ei, obs_dict in enumerate(self._obs):
+                for aid, ob in obs_dict.items():
+                    pid = self._policy_for(ei, aid)
+                    by_policy[pid].append(
+                        (ei, aid, self._preprocess(pid, ob)))
+            # One batched jitted call per policy.
+            actions: Dict = {}
+            for pid, slots in by_policy.items():
+                obs_batch = np.stack([s[2] for s in slots])
+                acts, _, extra = self.policy_map[pid].compute_actions(
+                    obs_batch, explore=self.explore)
+                for j, (ei, aid, pob) in enumerate(slots):
+                    row_extra = {k: v[j] for k, v in extra.items()}
+                    actions[(ei, aid)] = (acts[j], pob, row_extra)
+            # Step each env with its agents' actions.
+            for ei, env in enumerate(self.envs):
+                act_dict = {aid: actions[(ei, aid)][0]
+                            for aid in self._obs[ei]}
+                if not act_dict:
+                    continue
+                next_obs, rewards, dones, infos = env.step(act_dict)
+                env_steps += 1
+                self._ep_steps[ei] += 1
+                hit_horizon = bool(self.horizon
+                                   and self._ep_steps[ei] >= self.horizon)
+                all_done = bool(dones.get("__all__")) or hit_horizon
+                for aid in act_dict:
+                    a, pob, extra = actions[(ei, aid)]
+                    done = bool(dones.get(aid, False)) or all_done
+                    pid = self._policy_for(ei, aid)
+                    # next obs for this agent (may be absent if the agent
+                    # just exited): fall back to current obs.
+                    nob = next_obs.get(aid)
+                    nob_p = self._preprocess(pid, nob) \
+                        if nob is not None else pob
+                    b = self._builder_for(ei, aid)
+                    r = float(rewards.get(aid, 0.0))
+                    b.add(**{
+                        sb.OBS: pob,
+                        sb.ACTIONS: a,
+                        sb.REWARDS: np.float32(r),
+                        sb.DONES: done,
+                        sb.NEW_OBS: nob_p,
+                        sb.AGENT_INDEX: aid if isinstance(aid, int) else 0,
+                        sb.T: b.ep_len,
+                    }, **extra)
+                    b.ep_len += 1
+                    self._ep_reward[ei] += r
+                    if done:
+                        self._flush(ei, aid, chunks, bootstrap_obs=None)
+                if all_done:
+                    # Episode over: flush stragglers, record metrics, reset.
+                    for aid in list(self._obs[ei].keys()):
+                        self._flush(ei, aid, chunks, bootstrap_obs=None)
+                    self.metrics.append(RolloutMetrics(
+                        self._ep_steps[ei], self._ep_reward[ei]))
+                    self._obs[ei] = env.reset()
+                    self._ep_steps[ei] = 0
+                    self._ep_reward[ei] = 0.0
+                    self._eps_counter += 1
+                    self._eps_ids[ei] = self._eps_counter
+                    for key in [k for k in self._agent_policy
+                                if k[0] == ei]:
+                        del self._agent_policy[key]
+                else:
+                    # Drop agents that finished individually.
+                    self._obs[ei] = {
+                        aid: ob for aid, ob in next_obs.items()
+                        if not (dones.get(aid, False))}
+        # Fragment boundary: flush partials with bootstrap obs.
+        for (ei, aid) in list(self._builders.keys()):
+            pid = self._policy_for(ei, aid)
+            ob = self._obs[ei].get(aid)
+            boot = self._preprocess(pid, ob) if ob is not None else None
+            self._flush(ei, aid, chunks, bootstrap_obs=boot)
+        policy_batches = {
+            pid: SampleBatch.concat_samples(bs)
+            for pid, bs in chunks.items() if bs}
+        return MultiAgentBatch(policy_batches, env_steps)
+
+    def get_metrics(self) -> List[RolloutMetrics]:
+        out = self.metrics
+        self.metrics = []
+        return out

@@ -40,6 +40,14 @@ class RolloutWorker:
         self.worker_index = worker_index
         env_config = dict(env_config or {})
         env_config["worker_index"] = worker_index
+        multiagent = (policy_config.get("multiagent") or {}).get("policies")
+        if multiagent:
+            self._init_multiagent(
+                env_creator, policy_cls, policy_config, num_envs,
+                rollout_fragment_length, seed, explore, env_config,
+                horizon)
+            return
+        self.policy_map = None
         self.env = VectorEnv(lambda: env_creator(env_config), num_envs)
         if seed is not None:
             self.env.seed(seed + worker_index * 1000)
@@ -87,6 +95,72 @@ class RolloutWorker:
             preprocessor=self.preprocessor,
             pack_fragments=pack_fragments)
 
+    def _init_multiagent(self, env_creator, default_policy_cls,
+                         policy_config, num_envs,
+                         rollout_fragment_length, seed, explore,
+                         env_config, horizon):
+        """Policy-map worker (parity: `rollout_worker.py:114` — the ctor
+        builds one policy per spec in `multiagent.policies` and a
+        mapping fn routes agent ids to policies)."""
+        from ..utils.config import deep_merge
+        from .multi_agent_sampler import MultiAgentSyncSampler
+        if policy_config.get("observation_filter",
+                             "NoFilter") != "NoFilter":
+            raise NotImplementedError(
+                "observation_filter is not supported with a policy map "
+                "yet; use NoFilter")
+        ma_cfg = policy_config["multiagent"]
+        probe_env = env_creator(dict(env_config))
+        self.policy_map = {}
+        for idx, (pid, spec) in enumerate(ma_cfg["policies"].items()):
+            cls, obs_space, act_space, overrides = spec
+            cls = cls or default_policy_cls
+            obs_space = obs_space if obs_space is not None \
+                else probe_env.observation_space
+            act_space = act_space if act_space is not None \
+                else probe_env.action_space
+            cfg = deep_merge(deep_merge({}, policy_config),
+                             overrides or {})
+            cfg.pop("multiagent", None)
+            if seed is not None:
+                # Offset per policy so same-spec policies initialize
+                # independently rather than as identical twins.
+                cfg["seed"] = seed + self.worker_index + idx * 10007
+            self.policy_map[pid] = cls(obs_space, act_space, cfg)
+        probe_env.close()
+        self.policy = self.policy_map.get(
+            "default_policy", next(iter(self.policy_map.values())))
+        self.preprocessor = None
+        self.obs_filter = get_filter("NoFilter", ())
+        self.env = None
+        mapping = ma_cfg.get("policy_mapping_fn") \
+            or (lambda aid: next(iter(self.policy_map)))
+
+        def postprocess(pid, chunk, bootstrap_obs):
+            # Read GAE knobs from the policy's own merged config so
+            # per-policy overrides in `multiagent.policies` apply.
+            policy = self.policy_map[pid]
+            pcfg = policy.config
+            use_gae = pcfg.get("use_gae", True)
+            if bootstrap_obs is None or not use_gae:
+                last_r = 0.0
+            else:
+                last_r = float(policy.value_function(
+                    bootstrap_obs[None])[0])
+            if sb.VF_PREDS in chunk or use_gae:
+                chunk = compute_advantages(
+                    chunk, last_r, gamma=pcfg.get("gamma", 0.99),
+                    lambda_=pcfg.get("lambda", 1.0),
+                    use_gae=use_gae and sb.VF_PREDS in chunk,
+                    use_critic=pcfg.get("use_critic", True))
+            return policy.postprocess_trajectory(chunk)
+
+        self.sampler = MultiAgentSyncSampler(
+            env_creator, self.policy_map, mapping,
+            rollout_fragment_length, num_envs=num_envs,
+            postprocess_fn=postprocess, explore=explore,
+            horizon=horizon, env_config=env_config, seed=seed)
+
     # -- sampling --------------------------------------------------------
     def sample(self) -> SampleBatch:
         return self.sampler.sample()
@@ -97,6 +171,10 @@ class RolloutWorker:
 
     # -- learning (used when the worker doubles as a learner) ------------
     def learn_on_batch(self, batch) -> Dict:
+        from ..sample_batch import MultiAgentBatch
+        if isinstance(batch, MultiAgentBatch):
+            return {pid: self.policy_map[pid].learn_on_batch(b)
+                    for pid, b in batch.policy_batches.items()}
         return self.policy.learn_on_batch(batch)
 
     def compute_gradients(self, batch):
@@ -114,9 +192,16 @@ class RolloutWorker:
 
     # -- weights ---------------------------------------------------------
     def get_weights(self):
+        if self.policy_map is not None:
+            return {pid: p.get_weights()
+                    for pid, p in self.policy_map.items()}
         return self.policy.get_weights()
 
     def set_weights(self, weights):
+        if self.policy_map is not None:
+            for pid, w in weights.items():
+                self.policy_map[pid].set_weights(w)
+            return
         self.policy.set_weights(weights)
 
     # -- filters (parity: FilterManager.synchronize) ---------------------
@@ -135,26 +220,43 @@ class RolloutWorker:
         return fn(self, *args)
 
     def foreach_policy(self, fn):
-        """fn(policy, policy_id) over all policies (single-policy worker:
-        one entry; reference signature, `rollout_worker.py
-        foreach_policy`)."""
+        """fn(policy, policy_id) over all policies (reference signature,
+        `rollout_worker.py foreach_policy`)."""
+        if self.policy_map is not None:
+            return [fn(p, pid) for pid, p in self.policy_map.items()]
         return [fn(self.policy, "default_policy")]
+
+    def get_policy(self, policy_id: str = "default_policy"):
+        if self.policy_map is not None:
+            return self.policy_map[policy_id]
+        return self.policy
 
     # -- metrics / introspection -----------------------------------------
     def get_metrics(self) -> List:
         return self.sampler.get_metrics()
 
     def get_policy_state(self):
+        if self.policy_map is not None:
+            return {pid: p.get_state()
+                    for pid, p in self.policy_map.items()}
         return self.policy.get_state()
 
     def set_policy_state(self, state):
+        if self.policy_map is not None:
+            for pid, s in state.items():
+                self.policy_map[pid].set_state(s)
+            return
         self.policy.set_state(state)
 
     def ping(self):
         return "ok"
 
     def stop(self):
-        self.env.envs and [e.close() for e in self.env.envs]
+        if self.env is not None:
+            self.env.envs and [e.close() for e in self.env.envs]
+        elif self.policy_map is not None:
+            for e in self.sampler.envs:
+                e.close()
 
 
 def make_remote_worker_env() -> dict:

@@ -16,13 +16,14 @@ from __future__ import annotations
 
 import ray_tpu
 
-from ..sample_batch import SampleBatch
+from ..sample_batch import MultiAgentBatch, SampleBatch
 from .policy_optimizer import PolicyOptimizer
 
 
-def collect_train_batch(workers, train_batch_size: int) -> SampleBatch:
+def collect_train_batch(workers, train_batch_size: int):
     """Round-robin sample from remote workers (or the local worker) until
-    `train_batch_size` env steps are gathered."""
+    `train_batch_size` env steps are gathered. Returns a SampleBatch, or
+    a MultiAgentBatch when workers run a policy map."""
     batches = []
     count = 0
     if workers.remote_workers:
@@ -36,6 +37,8 @@ def collect_train_batch(workers, train_batch_size: int) -> SampleBatch:
             b = workers.local_worker.sample()
             batches.append(b)
             count += b.count
+    if isinstance(batches[0], MultiAgentBatch):
+        return MultiAgentBatch.concat_samples(batches)
     return SampleBatch.concat_samples(batches)
 
 
@@ -68,17 +71,31 @@ class MultiDeviceOptimizer(PolicyOptimizer):
         self.standardize_fields = standardize_fields
         self.learner_stats = {}
 
-    def step(self) -> dict:
-        import numpy as np
-        self.workers.sync_weights()
-        batch = collect_train_batch(self.workers, self.train_batch_size)
-        self.workers.sync_filters()
+    def _standardize(self, batch):
         for field in self.standardize_fields:
             if field in batch:
                 v = batch[field]
                 batch[field] = (v - v.mean()) / max(1e-4, v.std())
-        self.learner_stats = self.workers.local_worker.policy.sgd_learn(
-            batch, self.num_sgd_iter, self.sgd_minibatch_size)
+        return batch
+
+    def step(self) -> dict:
+        self.workers.sync_weights()
+        batch = collect_train_batch(self.workers, self.train_batch_size)
+        self.workers.sync_filters()
+        if isinstance(batch, MultiAgentBatch):
+            # Per-policy SGD phases (parity: the reference routes
+            # multi-agent through per-policy learn_on_batch).
+            worker = self.workers.local_worker
+            self.learner_stats = {
+                pid: worker.policy_map[pid].sgd_learn(
+                    self._standardize(b), self.num_sgd_iter,
+                    min(self.sgd_minibatch_size, b.count))
+                for pid, b in batch.policy_batches.items()}
+        else:
+            self._standardize(batch)
+            self.learner_stats = \
+                self.workers.local_worker.policy.sgd_learn(
+                    batch, self.num_sgd_iter, self.sgd_minibatch_size)
         self.num_steps_sampled += batch.count
         self.num_steps_trained += batch.count
         return self.learner_stats

@@ -1,0 +1,136 @@
+"""Continuous-control family: DDPG / TD3 / SAC / APEX-DDPG.
+
+Parity: the reference validates these by Pendulum regression yamls
+(`rllib/tuned_examples/regression_tests/pendulum-ddpg.yaml`,
+`pendulum-td3.yaml`, `pendulum-sac.yaml`).
+"""
+
+import numpy as np
+import pytest
+
+
+def td3_config(**overrides):
+    cfg = {
+        "env": "Pendulum-v0",
+        "num_workers": 0,
+        "actor_hiddens": [64, 64],
+        "critic_hiddens": [64, 64],
+        "actor_lr": 1e-3,
+        "critic_lr": 1e-3,
+        "buffer_size": 40000,
+        "learning_starts": 500,
+        "pure_exploration_steps": 500,
+        "exploration_noise_sigma": 0.1,
+        "train_batch_size": 128,
+        "rollout_fragment_length": 1,
+        "timesteps_per_iteration": 600,
+        # Pendulum episodes end only by time limit.
+        "no_done_at_end": True,
+        "seed": 0,
+    }
+    cfg.update(overrides)
+    return cfg
+
+
+class TestTD3:
+    def test_td3_learns_pendulum(self):
+        from ray_tpu.rllib.agents.ddpg import TD3Trainer
+        t = TD3Trainer(config=td3_config(
+            evaluation_interval=3, evaluation_num_episodes=3))
+        best = -1e9
+        for _ in range(36):
+            r = t.train()
+            # judge by deterministic eval episodes: the smoothed training
+            # metric keeps the pure-exploration phase in its window
+            if "evaluation" in r:
+                best = max(best, r["evaluation"]["episode_reward_mean"])
+                if best >= -300:
+                    break
+        t.stop()
+        # random policy sits around -1200; solved is > -200
+        assert best >= -300, f"TD3 failed to learn Pendulum: best={best}"
+
+    def test_ddpg_improves_and_checkpoints(self, tmp_path):
+        from ray_tpu.rllib.agents.ddpg import DDPGTrainer
+        t = DDPGTrainer(config=td3_config(
+            twin_q=False, policy_delay=1, smooth_target_policy=False,
+            exploration_ou=True, prioritized_replay=True))
+        for _ in range(3):
+            r = t.train()
+        path = t.save(str(tmp_path))
+        obs = np.array([1.0, 0.0, 0.0], np.float32)
+        a1 = t.compute_action(obs, explore=False)
+        t.stop()
+
+        t2 = DDPGTrainer(config=td3_config(
+            twin_q=False, policy_delay=1, smooth_target_policy=False,
+            exploration_ou=True, prioritized_replay=True))
+        t2.restore(path)
+        a2 = t2.compute_action(obs, explore=False)
+        np.testing.assert_allclose(np.asarray(a1), np.asarray(a2),
+                                   atol=1e-5)
+        t2.stop()
+
+
+class TestSAC:
+    def test_sac_learns_pendulum(self):
+        from ray_tpu.rllib.agents.sac import SACTrainer
+        t = SACTrainer(config={
+            "env": "Pendulum-v0",
+            "num_workers": 0,
+            "actor_hiddens": [64, 64],
+            "critic_hiddens": [64, 64],
+            "buffer_size": 40000,
+            "learning_starts": 500,
+            "pure_exploration_steps": 500,
+            "train_batch_size": 128,
+            "rollout_fragment_length": 1,
+            "timesteps_per_iteration": 600,
+            "no_done_at_end": True,
+            "evaluation_interval": 3,
+            "evaluation_num_episodes": 3,
+            "seed": 0,
+        })
+        best = -1e9
+        alpha = None
+        for _ in range(36):
+            r = t.train()
+            alpha = r["info"]["learner"].get("alpha", alpha)
+            if "evaluation" in r:
+                best = max(best, r["evaluation"]["episode_reward_mean"])
+                if best >= -300:
+                    break
+        t.stop()
+        assert best >= -300, f"SAC failed to learn Pendulum: best={best}"
+        # entropy temperature must have auto-tuned away from its init
+        assert alpha is not None and alpha < 1.0
+
+    def test_sac_registry_and_cli_name(self):
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        for name in ("SAC", "DDPG", "TD3", "APEX_DDPG"):
+            assert get_trainer_class(name) is not None
+
+
+class TestApexDDPG:
+    def test_apex_ddpg_smoke(self, ray_start):
+        """APEX-DDPG plumbing: sharded replay actors + learner thread."""
+        from ray_tpu.rllib.agents.ddpg import ApexDDPGTrainer
+        t = ApexDDPGTrainer(config={
+            "env": "Pendulum-v0",
+            "num_workers": 2,
+            "actor_hiddens": [32, 32],
+            "critic_hiddens": [32, 32],
+            "optimizer": {"num_replay_buffer_shards": 2,
+                          "max_weight_sync_delay": 50},
+            "buffer_size": 5000,
+            "learning_starts": 200,
+            "pure_exploration_steps": 100,
+            "train_batch_size": 64,
+            "rollout_fragment_length": 25,
+            "timesteps_per_iteration": 500,
+            "min_iter_time_s": 0,
+            "seed": 0,
+        })
+        r = t.train()
+        assert r["timesteps_total"] >= 500
+        t.stop()

@@ -132,3 +132,27 @@ class TestRemoteWorkers:
         assert r["timesteps_total"] >= 256
         assert r["episodes_this_iter"] > 0
         t.stop()
+
+
+class TestEvaluation:
+    def test_evaluation_workers(self):
+        """evaluation_interval spawns a deterministic eval worker
+        (parity: reference trainer.py:560)."""
+        from ray_tpu.rllib.agents.pg import PGTrainer
+        t = PGTrainer(config={
+            "env": "CartPole-v0",
+            "num_workers": 0,
+            "train_batch_size": 128,
+            "rollout_fragment_length": 64,
+            "evaluation_interval": 2,
+            "evaluation_num_episodes": 3,
+            "seed": 0,
+        })
+        r1 = t.train()
+        assert "evaluation" not in r1
+        r2 = t.train()
+        assert "evaluation" in r2
+        ev = r2["evaluation"]
+        assert ev["episodes_this_iter"] >= 3
+        assert np.isfinite(ev["episode_reward_mean"])
+        t.stop()
