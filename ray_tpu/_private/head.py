@@ -1,18 +1,26 @@
-"""Head server: cluster metadata + lease-based scheduler + process supervisor.
+"""Head server: cluster metadata + lease-based scheduler + node registry.
 
-Parity: this one component plays the roles of the reference's GCS
+Parity: this component plays the roles of the reference's GCS
 (`src/ray/gcs/gcs_server/` — metadata tables, pub/sub, actor directory, KV),
 the raylet NodeManager (`src/ray/raylet/node_manager.h` — resource
-accounting, worker leases, dispatch), the WorkerPool
-(`src/ray/raylet/worker_pool.h` — spawning/registering worker processes) and
-the raylet monitor (`src/ray/raylet/monitor.cc` — death detection). It runs
-as threads inside the driver process (like `ray.init()`'s head node) and
-speaks the protocol in `protocol.py`.
+accounting, worker leases, dispatch, spillback between nodes), the
+WorkerPool (`src/ray/raylet/worker_pool.h`) and the raylet monitor
+(`src/ray/raylet/monitor.cc` — death detection). It runs as threads inside
+the driver process and speaks the protocol in `protocol.py`.
 
-Scheduling follows the reference's *direct call* generation only
-(`direct_task_transport.h`): the head grants a worker lease per task and the
-data plane (args/results) flows directly between workers; the head never
-touches object payloads.
+Multi-node: the head owns a registry of nodes. Its own node ("node0")
+spawns workers directly; additional nodes register a NodeAgent connection
+(`node_agent.py`) which spawns and supervises workers on that node
+(reference: one raylet per node; here spawn requests flow head→agent and
+death notifications agent→head, standing in for raylet heartbeats +
+`HandleUnexpectedWorkerFailure`, `node_manager.h:125`). Task placement
+walks nodes in registration order and leases a worker on the first node
+whose resource vector fits — the degenerate one-node case reduces to the
+reference's local lease path, and a remote fit is the reference's
+spillback (`scheduling_policy.h:35`).
+
+Workers are matched to their spawn records by a token minted at spawn
+time and echoed in the worker's hello (avoids pid races across nodes).
 """
 
 from __future__ import annotations
@@ -58,8 +66,13 @@ class ActorInfo:
 
 
 class WorkerInfo:
-    def __init__(self, proc: subprocess.Popen):
-        self.proc = proc
+    def __init__(self, node_id: str, token: str,
+                 proc: Optional[subprocess.Popen] = None):
+        self.node_id = node_id
+        self.token = token
+        self.proc = proc  # only for node0 (head-local) workers
+        self.pid: Optional[int] = proc.pid if proc else None
+        self.returncode: Optional[int] = None
         self.addr: Optional[str] = None
         self.conn: Optional[protocol.Connection] = None
         self.registered = threading.Event()
@@ -67,36 +80,78 @@ class WorkerInfo:
         self.actor_id: Optional[ActorID] = None  # dedicated actor worker
         self.dedicated = False
         self.started_at = time.monotonic()
+        self._reaped = False
+
+
+class NodeInfo:
+    """One schedulable node: its resource vector + worker pool state."""
+
+    def __init__(self, node_id: str, resources: Dict[str, float],
+                 conn: Optional[protocol.Connection] = None):
+        self.node_id = node_id
+        self.total = dict(resources)
+        self.available = dict(resources)
+        self.conn = conn  # None for the head-local node
+        self.idle: deque = deque()  # addrs of idle pool workers
+        self.spawning_pool = 0  # pool workers requested but unregistered
+        self.alive = True
+
+    def fits(self, resources: Dict[str, float]) -> bool:
+        return all(self.available.get(k, 0.0) + 1e-9 >= v
+                   for k, v in resources.items())
+
+    def acquire(self, resources: Dict[str, float]):
+        for k, v in resources.items():
+            self.available[k] = self.available.get(k, 0.0) - v
+
+    def release(self, resources: Dict[str, float]):
+        for k, v in resources.items():
+            self.available[k] = self.available.get(k, 0.0) + v
+
+    def view(self) -> dict:
+        return {"node_id": self.node_id, "alive": self.alive,
+                "total_resources": dict(self.total),
+                "available_resources": dict(self.available)}
 
 
 class HeadServer:
     def __init__(self, session_dir: str, session_name: str,
-                 resources: Dict[str, float], worker_env: Optional[dict] = None):
+                 resources: Dict[str, float],
+                 worker_env: Optional[dict] = None,
+                 enable_tcp: bool = False):
         self.session_dir = session_dir
         self.session_name = session_name
         self.sock_path = os.path.join(session_dir, "head.sock")
-        self.total_resources = dict(resources)
-        self.available = dict(resources)
         self.worker_env = worker_env or {}
 
         self._lock = threading.RLock()
         self._kv: Dict[str, bytes] = {}
         self._subs: Dict[str, Set[protocol.Connection]] = {}
+        self._nodes: Dict[str, NodeInfo] = {
+            "node0": NodeInfo("node0", resources)}
         self._workers: Dict[str, WorkerInfo] = {}  # by addr once registered
-        self._spawned: List[WorkerInfo] = []  # registered or not
-        self._idle: deque = deque()  # addrs of idle pool workers
+        self._spawned: Dict[str, WorkerInfo] = {}  # by token
         self._pending: deque = deque()  # TaskSpec queue
         self._inflight: Dict[TaskID, str] = {}  # task -> worker addr
         self._actors: Dict[ActorID, ActorInfo] = {}
         self._drivers: Set[protocol.Connection] = set()
         self._conns_by_addr: Dict[str, protocol.Connection] = {}
         self._shutdown = False
-        # Number of pool workers being spawned that haven't registered yet.
-        self._spawning_pool = 0
+        self._token_counter = 0
+        self._unregistered_deaths = 0
 
         self.server = protocol.Server(
             self.sock_path, self._handle, on_connect=self._on_connect,
             on_close=self._on_conn_close)
+        # Optional TCP plane for node agents / remote-node workers
+        # (reference: the gRPC services every raylet/worker exposes).
+        self.tcp_server = None
+        self.tcp_addr = None
+        if enable_tcp:
+            self.tcp_server = protocol.Server(
+                "tcp://127.0.0.1:0", self._handle,
+                on_connect=self._on_connect, on_close=self._on_conn_close)
+            self.tcp_addr = self.tcp_server.path
         self._monitor_thread = threading.Thread(
             target=self._monitor_loop, daemon=True, name="head-monitor")
         self._monitor_thread.start()
@@ -110,28 +165,47 @@ class HeadServer:
             self._conns_by_addr[conn.peer_addr] = conn
             if role == "driver":
                 self._drivers.add(conn)
+            elif role == "node":
+                node_id = hello["node_id"]
+                self._nodes[node_id] = NodeInfo(
+                    node_id, hello.get("resources") or {}, conn=conn)
+                conn.node_id = node_id
+                logger.info("node %s registered (%s)", node_id,
+                            hello.get("resources"))
             elif role == "worker":
-                pid = hello.get("pid")
-                for w in self._spawned:
-                    if w.proc.pid == pid and w.addr is None:
-                        w.addr = conn.peer_addr
-                        w.conn = conn
-                        self._workers[conn.peer_addr] = w
-                        if not w.dedicated:
-                            self._spawning_pool -= 1
-                            self._idle.append(conn.peer_addr)
-                        w.registered.set()
-                        break
+                token = hello.get("token", "")
+                w = self._spawned.get(token)
+                if w is None:
+                    logger.warning("unknown worker registered token=%s "
+                                   "pid=%s", token, hello.get("pid"))
                 else:
-                    logger.warning("unknown worker registered pid=%s", pid)
+                    w.addr = conn.peer_addr
+                    w.conn = conn
+                    w.pid = hello.get("pid", w.pid)
+                    node = self._nodes.get(w.node_id)
+                    if node is None:
+                        # Its node died while it was booting: orphan.
+                        try:
+                            conn.send({"kind": "shutdown"})
+                        except protocol.ConnectionClosed:
+                            pass
+                        return
+                    self._workers[conn.peer_addr] = w
+                    if not w.dedicated:
+                        node.spawning_pool -= 1
+                        node.idle.append(conn.peer_addr)
+                    w.registered.set()
             self._schedule_locked()
 
     def _on_conn_close(self, conn: protocol.Connection):
+        node_id = getattr(conn, "node_id", None)
         with self._lock:
             self._conns_by_addr.pop(conn.peer_addr, None)
             self._drivers.discard(conn)
             for subs in self._subs.values():
                 subs.discard(conn)
+        if node_id is not None:
+            self._handle_node_death(node_id)
 
     # ------------------------------------------------------------------
     # message handling
@@ -204,11 +278,23 @@ class HeadServer:
             w = self._workers.get(addr)
             if w is not None and w.current_task is not None \
                     and w.current_task.task_id == task_id:
-                self._release_resources(w.current_task.resources)
+                node = self._nodes.get(w.node_id)
+                if node is not None:
+                    node.release(w.current_task.resources)
                 w.current_task = None
-                if not w.dedicated:
-                    self._idle.append(addr)
+                if not w.dedicated and node is not None:
+                    node.idle.append(addr)
             self._schedule_locked()
+
+    # -- worker lifecycle from node agents -------------------------------
+    def _h_worker_died(self, conn, msg):
+        with self._lock:
+            w = self._spawned.get(msg["token"])
+            if w is None or w._reaped:
+                return
+            w._reaped = True
+            w.returncode = msg.get("returncode")
+        self._handle_worker_death(w)
 
     # -- actors ----------------------------------------------------------
     def _h_create_actor(self, conn, msg):
@@ -236,9 +322,13 @@ class HeadServer:
             info.state = ALIVE
             info.addr = msg["addr"]
             self._inflight.pop(info.spec.task_id, None)
-            # Creation lease resources are released; the actor holds its
-            # declared (usually zero) lifetime resources.
-            self._release_resources(info.spec.resources)
+            # Creation lease resources are released on the node they were
+            # acquired on; if that node is already gone, so is its
+            # accounting — releasing elsewhere would over-credit it.
+            # Clearing current_task stops the worker's eventual death
+            # from releasing the same lease a second time.
+            self._release_creation_resources_locked(info,
+                                                    clear_task=True)
             view = info.view()
         self._publish("actor:" + actor_id.hex(), view)
 
@@ -251,10 +341,27 @@ class HeadServer:
             info.state = DEAD
             info.death_reason = f"creation failed: {msg.get('error')}"
             self._inflight.pop(info.spec.task_id, None)
-            self._release_resources(info.spec.resources)
+            self._release_creation_resources_locked(info, clear_task=True)
             self._release_actor_name_locked(info)
             view = info.view()
         self._publish("actor:" + actor_id.hex(), view)
+
+    def _release_creation_resources_locked(self, info: ActorInfo,
+                                           clear_task: bool = False):
+        # Find the node the creation lease was placed on via its worker
+        # (the newest live one — restarts leave reaped records behind);
+        # a vanished node's accounting died with it — never release onto
+        # a different node.
+        candidates = [w for w in self._spawned.values()
+                      if w.actor_id == info.spec.actor_id]
+        w = next((x for x in reversed(candidates) if not x._reaped),
+                 candidates[-1] if candidates else None)
+        if w is not None:
+            node = self._nodes.get(w.node_id)
+            if node is not None:
+                node.release(info.spec.resources)
+            if clear_task and w.current_task is info.spec:
+                w.current_task = None
 
     def _h_resolve_actor(self, conn, msg):
         actor_id: ActorID = msg["actor_id"]
@@ -285,19 +392,39 @@ class HeadServer:
                 info.restarts_left = 0
             w = self._workers.get(info.addr) if info.addr else None
         if w is not None:
+            self._kill_worker(w)
+        if "seq" in msg:
+            conn.reply(msg, ok=True)
+
+    def _kill_worker(self, w: WorkerInfo):
+        if w.proc is not None:
             try:
                 w.proc.kill()
             except OSError:
                 pass
-        if "seq" in msg:
-            conn.reply(msg, ok=True)
+            return
+        node = self._nodes.get(w.node_id)
+        if node is not None and node.conn is not None:
+            try:
+                node.conn.send({"kind": "kill_worker", "token": w.token})
+            except protocol.ConnectionClosed:
+                pass
 
     # -- introspection ---------------------------------------------------
     def _h_cluster_info(self, conn, msg):
         with self._lock:
+            nodes = {nid: n.view() for nid, n in self._nodes.items()}
+            total: Dict[str, float] = {}
+            avail: Dict[str, float] = {}
+            for n in self._nodes.values():
+                for k, v in n.total.items():
+                    total[k] = total.get(k, 0.0) + v
+                for k, v in n.available.items():
+                    avail[k] = avail.get(k, 0.0) + v
             info = {
-                "total_resources": dict(self.total_resources),
-                "available_resources": dict(self.available),
+                "total_resources": total,
+                "available_resources": avail,
+                "nodes": nodes,
                 "num_workers": len(self._workers),
                 "num_pending_tasks": len(self._pending),
                 "actors": {a.hex(): i.view() for a, i in self._actors.items()},
@@ -312,26 +439,43 @@ class HeadServer:
     # ------------------------------------------------------------------
     # scheduling (lease grant) — runs under self._lock
     # ------------------------------------------------------------------
-    def _fits(self, resources: Dict[str, float]) -> bool:
-        return all(self.available.get(k, 0.0) + 1e-9 >= v
-                   for k, v in resources.items())
-
-    def _acquire_resources(self, resources: Dict[str, float]):
-        for k, v in resources.items():
-            self.available[k] = self.available.get(k, 0.0) - v
-
-    def _release_resources(self, resources: Dict[str, float]):
-        for k, v in resources.items():
-            self.available[k] = self.available.get(k, 0.0) + v
+    def _pick_node_locked(self, spec: TaskSpec) -> Optional[NodeInfo]:
+        """First-fit across nodes, local node first (the remote fit is the
+        reference's spillback, `scheduling_policy.h:35`)."""
+        for node in self._nodes.values():
+            if node.alive and node.fits(spec.resources):
+                return node
+        return None
 
     def _schedule_locked(self):
         if self._shutdown:
             return
         remaining = deque()
-        need_worker = 0
+        # pool-worker deficit per node for runnable-but-unassigned tasks
+        need_worker: Dict[str, int] = {}
+        try:
+            self._drain_pending_locked(remaining, need_worker)
+        finally:
+            # Never lose queued tasks, even if a spawn/send throws
+            # mid-drain (e.g. an agent connection breaking).
+            self._pending = remaining
+        for node_id, need in need_worker.items():
+            node = self._nodes.get(node_id)
+            if node is None:
+                continue
+            for _ in range(max(0, need - node.spawning_pool)):
+                try:
+                    self._spawn_worker(node, dedicated=False)
+                except Exception:
+                    logger.exception("failed to grow pool on %s", node_id)
+                    break
+
+    def _drain_pending_locked(self, remaining: deque,
+                              need_worker: Dict[str, int]):
         while self._pending:
             spec = self._pending.popleft()
-            if not self._fits(spec.resources):
+            node = self._pick_node_locked(spec)
+            if node is None:
                 remaining.append(spec)
                 continue
             if spec.kind == ACTOR_CREATION_TASK:
@@ -339,7 +483,7 @@ class HeadServer:
                 if info is None:
                     continue
                 try:
-                    w = self._spawn_worker(dedicated=True,
+                    w = self._spawn_worker(node, dedicated=True,
                                            extra_env=spec.env_vars)
                 except Exception as e:
                     # A bad spawn (e.g. unpicklable env) must not abort the
@@ -353,18 +497,18 @@ class HeadServer:
                     continue
                 w.actor_id = spec.actor_id
                 w.current_task = spec
-                info.worker_pid = w.proc.pid
-                self._acquire_resources(spec.resources)
-                self._inflight[spec.task_id] = f"pid:{w.proc.pid}"
+                info.worker_pid = w.pid
+                node.acquire(spec.resources)
+                self._inflight[spec.task_id] = f"token:{w.token}"
                 threading.Thread(
                     target=self._dispatch_when_registered, args=(w, spec),
                     daemon=True).start()
             else:
-                if self._idle:
-                    addr = self._idle.popleft()
+                if node.idle:
+                    addr = node.idle.popleft()
                     w = self._workers[addr]
                     w.current_task = spec
-                    self._acquire_resources(spec.resources)
+                    node.acquire(spec.resources)
                     self._inflight[spec.task_id] = addr
                     try:
                         w.conn.send({"kind": "execute_task", "spec": spec})
@@ -372,16 +516,14 @@ class HeadServer:
                         pass  # death handling will requeue/fail it
                 else:
                     remaining.append(spec)
-                    need_worker += 1
-        # Grow the pool for runnable-but-unassigned tasks (reference:
-        # WorkerPool starts workers on demand for lease requests).
-        for _ in range(max(0, need_worker - self._spawning_pool)):
-            self._spawn_worker(dedicated=False)
-        self._pending = remaining
+                    # Pool growth happens after the drain (reference:
+                    # WorkerPool starts workers on demand for leases).
+                    need_worker[node.node_id] = \
+                        need_worker.get(node.node_id, 0) + 1
 
     def _dispatch_when_registered(self, w: WorkerInfo, spec: TaskSpec):
         if not w.registered.wait(timeout=60):
-            logger.error("worker pid=%s never registered", w.proc.pid)
+            logger.error("worker token=%s never registered", w.token)
             return
         with self._lock:
             if w.current_task is not spec:
@@ -392,14 +534,38 @@ class HeadServer:
             except protocol.ConnectionClosed:
                 pass
 
-    def _spawn_worker(self, dedicated: bool,
+    def _next_token(self) -> str:
+        self._token_counter += 1
+        return f"w{self._token_counter}-{os.urandom(3).hex()}"
+
+    def _spawn_worker(self, node: NodeInfo, dedicated: bool,
                       extra_env: Optional[dict] = None) -> WorkerInfo:
+        token = self._next_token()
+        if node.conn is None:
+            w = self._spawn_local_worker(token, dedicated, extra_env)
+        else:
+            # Remote node: the agent forks the worker (reference: raylet
+            # WorkerPool on the task's node).
+            node.conn.send({"kind": "spawn_worker", "token": token,
+                            "dedicated": dedicated,
+                            "env": dict(extra_env or {})})
+            w = WorkerInfo(node.node_id, token, proc=None)
+        w.dedicated = dedicated
+        self._spawned[token] = w
+        if not dedicated:
+            node.spawning_pool += 1
+        return w
+
+    def _spawn_local_worker(self, token: str, dedicated: bool,
+                            extra_env: Optional[dict]) -> WorkerInfo:
         env = dict(os.environ)
         env.update(self.worker_env)
         if extra_env:
             env.update(extra_env)
         env["RAY_TPU_SESSION_DIR"] = self.session_dir
         env["RAY_TPU_SESSION_NAME"] = self.session_name
+        env["RAY_TPU_NODE_ID"] = "node0"
+        env["RAY_TPU_WORKER_TOKEN"] = token
         # Workers must see the same import universe as the driver (parity:
         # the reference serializes the driver's sys.path expectations via the
         # worker command line, `services.py:1099`).
@@ -417,12 +583,7 @@ class HeadServer:
             stdout=open(os.path.join(log_path, "worker-pending.out"), "ab"),
             stderr=subprocess.STDOUT,
         )
-        w = WorkerInfo(proc)
-        w.dedicated = dedicated
-        self._spawned.append(w)
-        if not dedicated:
-            self._spawning_pool += 1
-        return w
+        return WorkerInfo("node0", token, proc=proc)
 
     # ------------------------------------------------------------------
     # death detection (reference: raylet monitor heartbeats + SIGCHLD)
@@ -432,34 +593,40 @@ class HeadServer:
             time.sleep(0.05)
             dead: List[WorkerInfo] = []
             with self._lock:
-                for w in self._spawned:
-                    if w.proc.poll() is not None and not getattr(w, "_reaped", False):
+                for w in self._spawned.values():
+                    if w.proc is not None and w.proc.poll() is not None \
+                            and not w._reaped:
                         w._reaped = True
+                        w.returncode = w.proc.returncode
                         dead.append(w)
             for w in dead:
                 self._handle_worker_death(w)
 
-    def _handle_worker_death(self, w: WorkerInfo):
+    def _handle_worker_death(self, w: WorkerInfo, node_death: bool = False):
         failed_boot = False
         with self._lock:
+            node = self._nodes.get(w.node_id)
             if w.addr is not None:
                 self._unregistered_deaths = 0
                 self._workers.pop(w.addr, None)
-                try:
-                    self._idle.remove(w.addr)
-                except ValueError:
-                    pass
+                if node is not None:
+                    try:
+                        node.idle.remove(w.addr)
+                    except ValueError:
+                        pass
             else:
-                # Died before registering: almost always an import/boot
-                # failure — make it visible instead of crash-looping.
-                if not w.dedicated:
-                    self._spawning_pool -= 1
-                self._unregistered_deaths = getattr(
-                    self, "_unregistered_deaths", 0) + 1
-                failed_boot = self._unregistered_deaths >= 3
-        if w.addr is None:
+                if not w.dedicated and node is not None:
+                    node.spawning_pool -= 1
+                if not node_death:
+                    # Died before registering: almost always an import/
+                    # boot failure — make it visible instead of
+                    # crash-looping. (A node death taking booting workers
+                    # with it is NOT a boot loop.)
+                    self._unregistered_deaths += 1
+                    failed_boot = self._unregistered_deaths >= 3
+        if w.addr is None and not node_death:
             self._publish("error", (
-                f"worker pid={w.proc.pid} exited (code {w.proc.returncode}) "
+                f"worker pid={w.pid} exited (code {w.returncode}) "
                 f"before registering; see {self.session_dir}/logs/"))
         if failed_boot:
             # Stop respawning into a boot loop: fail everything pending.
@@ -479,7 +646,9 @@ class HeadServer:
             actor_id = w.actor_id
             if spec is not None:
                 self._inflight.pop(spec.task_id, None)
-                self._release_resources(spec.resources)
+                node = self._nodes.get(w.node_id)
+                if node is not None:
+                    node.release(spec.resources)
             retry = (spec is not None and actor_id is None
                      and spec.retries_used < spec.max_retries)
             if retry:
@@ -491,8 +660,26 @@ class HeadServer:
             self._handle_actor_death(actor_id, w)
         elif spec is not None and not retry:
             self._fail_task_to_caller(spec, WorkerCrashedError(
-                f"worker pid={w.proc.pid} died while running "
-                f"{spec.describe()} (exit code {w.proc.returncode})"))
+                f"worker pid={w.pid} died while running "
+                f"{spec.describe()} (exit code {w.returncode})"))
+
+    def _handle_node_death(self, node_id: str):
+        """A node agent disconnected: declare its workers dead (reference:
+        raylet monitor marking a node dead after missed heartbeats,
+        `monitor.cc`)."""
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None:
+                return
+            node.alive = False
+            victims = [w for w in self._spawned.values()
+                       if w.node_id == node_id and not w._reaped]
+            for w in victims:
+                w._reaped = True
+            del self._nodes[node_id]
+        self._publish("error", f"node {node_id} died")
+        for w in victims:
+            self._handle_worker_death(w, node_death=True)
 
     def _handle_actor_death(self, actor_id: ActorID, w: WorkerInfo):
         with self._lock:
@@ -512,7 +699,7 @@ class HeadServer:
                 self._schedule_locked()
             else:
                 info.state = DEAD
-                info.death_reason = f"worker pid={w.proc.pid} exited"
+                info.death_reason = f"worker pid={w.pid} exited"
                 info.addr = None
                 self._release_actor_name_locked(info)
                 view = info.view()
@@ -542,13 +729,21 @@ class HeadServer:
     # ------------------------------------------------------------------
     def start_pool_workers(self, n: int):
         with self._lock:
+            node = self._nodes["node0"]
             for _ in range(n):
-                self._spawn_worker(dedicated=False)
+                self._spawn_worker(node, dedicated=False)
 
     def shutdown(self):
         with self._lock:
             self._shutdown = True
-            workers = list(self._spawned)
+            workers = list(self._spawned.values())
+            agents = [n.conn for n in self._nodes.values()
+                      if n.conn is not None]
+        for conn in agents:
+            try:
+                conn.send({"kind": "shutdown"})
+            except protocol.ConnectionClosed:
+                pass
         for w in workers:
             if w.conn is not None:
                 try:
@@ -557,6 +752,8 @@ class HeadServer:
                     pass
         deadline = time.monotonic() + 2.0
         for w in workers:
+            if w.proc is None:
+                continue
             remaining = deadline - time.monotonic()
             try:
                 w.proc.wait(timeout=max(0.05, remaining))
@@ -567,3 +764,5 @@ class HeadServer:
                 except OSError:
                     pass
         self.server.close()
+        if self.tcp_server is not None:
+            self.tcp_server.close()

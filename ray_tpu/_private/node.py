@@ -49,7 +49,8 @@ def detect_tpus() -> float:
 class Node:
     def __init__(self, resources: Dict[str, float], num_initial_workers: int,
                  session_root: Optional[str] = None,
-                 worker_env: Optional[dict] = None):
+                 worker_env: Optional[dict] = None,
+                 enable_tcp: bool = False):
         ts = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
         self.session_name = f"{ts}-{os.getpid()}-{os.urandom(2).hex()}"
         # Note: deliberately NOT "<tmp>/ray_tpu" — a directory named like the
@@ -60,11 +61,15 @@ class Node:
         self.session_dir = os.path.join(root, f"session_{self.session_name}")
         os.makedirs(self.session_dir, exist_ok=True)
         self.head = HeadServer(self.session_dir, self.session_name, resources,
-                               worker_env=worker_env)
+                               worker_env=worker_env, enable_tcp=enable_tcp)
         if num_initial_workers > 0:
             self.head.start_pool_workers(num_initial_workers)
+        # In a multi-node (TCP) session the driver dials the head over TCP
+        # so its own server binds TCP too — workers on other nodes must be
+        # able to push results back to the driver.
+        head_addr = self.head.tcp_addr if enable_tcp else self.head.sock_path
         self.runtime = Runtime(self.session_dir, self.session_name,
-                               self.head.sock_path, role="driver")
+                               head_addr, role="driver")
 
     def shutdown(self):
         try:
@@ -79,7 +84,8 @@ def init(resources: Optional[Dict[str, float]] = None,
          num_cpus: Optional[float] = None,
          num_tpus: Optional[float] = None,
          num_initial_workers: int = 0,
-         worker_env: Optional[dict] = None) -> "Node":
+         worker_env: Optional[dict] = None,
+         enable_tcp: bool = False) -> "Node":
     global _node
     with _lock:
         if _node is not None:
@@ -93,7 +99,8 @@ def init(resources: Optional[Dict[str, float]] = None,
             res["TPU"] = float(tpus)
         if resources:
             res.update({k: float(v) for k, v in resources.items()})
-        node = Node(res, num_initial_workers, worker_env=worker_env)
+        node = Node(res, num_initial_workers, worker_env=worker_env,
+                    enable_tcp=enable_tcp)
         _node = node
         worker_state.set_runtime(node.runtime, worker_state.SCRIPT_MODE)
         atexit.register(_atexit_shutdown)

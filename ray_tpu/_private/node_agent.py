@@ -1,0 +1,172 @@
+"""Node agent: per-node worker pool + death reporter.
+
+Parity: the per-node half of the reference's raylet
+(`src/ray/raylet/worker_pool.h` — forking workers on lease demand — plus
+the death-notification side of `node_manager.h:125`
+`HandleUnexpectedWorkerFailure`). The head remains the scheduler; the
+agent is its arm on this node: it registers the node's resource vector,
+forks worker processes when the head asks, watches them, and reports
+exits. Workers connect straight to the head for dispatch (the reference's
+direct-call generation — the raylet grants leases but tasks flow
+worker-to-worker).
+
+Run one per (simulated or real) node:
+
+    python -m ray_tpu._private.node_agent --head-addr tcp://h:p \
+        --node-id nodeA --resources '{"CPU": 4}' \
+        --session-dir /tmp/... --session-name s
+
+In-process multi-node tests boot several of these against one head
+(`ray_tpu/cluster_utils.py`), mirroring the reference's
+`cluster_utils.Cluster` trick (`python/ray/cluster_utils.py:12`).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, Optional
+
+from . import protocol
+
+logger = logging.getLogger(__name__)
+
+
+class NodeAgent:
+    def __init__(self, head_addr: str, node_id: str,
+                 resources: Dict[str, float], session_dir: str,
+                 session_name: str,
+                 worker_env: Optional[dict] = None):
+        self.head_addr = head_addr
+        self.node_id = node_id
+        self.session_dir = session_dir
+        self.session_name = session_name
+        self.worker_env = worker_env or {}
+        self._procs: Dict[str, subprocess.Popen] = {}  # token -> proc
+        self._lock = threading.Lock()
+        self._shutdown = threading.Event()
+        os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+
+        self.head = protocol.connect(
+            head_addr, f"agent:{node_id}", self._handle,
+            hello_extra={"role": "node", "node_id": node_id,
+                         "resources": dict(resources), "pid": os.getpid()},
+            on_close=self._on_head_close)
+        self._monitor_thread = threading.Thread(
+            target=self._monitor_loop, daemon=True, name="agent-monitor")
+        self._monitor_thread.start()
+
+    # ------------------------------------------------------------------
+    def _handle(self, conn: protocol.Connection, msg: dict):
+        kind = msg["kind"]
+        if kind == "spawn_worker":
+            self._spawn_worker(msg["token"], msg.get("env") or {})
+        elif kind == "kill_worker":
+            with self._lock:
+                proc = self._procs.get(msg["token"])
+            if proc is not None:
+                try:
+                    proc.kill()
+                except OSError:
+                    pass
+        elif kind == "shutdown":
+            self.shutdown()
+        else:
+            logger.warning("agent: unknown message %s", kind)
+
+    def _spawn_worker(self, token: str, extra_env: Dict[str, str]):
+        env = dict(os.environ)
+        env.update(self.worker_env)
+        env.update(extra_env)
+        env["RAY_TPU_SESSION_DIR"] = self.session_dir
+        env["RAY_TPU_SESSION_NAME"] = self.session_name
+        env["RAY_TPU_NODE_ID"] = self.node_id
+        env["RAY_TPU_WORKER_TOKEN"] = token
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p] +
+            ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+        log = open(os.path.join(self.session_dir, "logs",
+                                f"worker-{self.node_id}.out"), "ab")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.default_worker",
+             "--head-sock", self.head_addr,
+             "--session-dir", self.session_dir,
+             "--session-name", self.session_name],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+        with self._lock:
+            self._procs[token] = proc
+
+    # ------------------------------------------------------------------
+    def _monitor_loop(self):
+        while not self._shutdown.is_set():
+            time.sleep(0.05)
+            dead = []
+            with self._lock:
+                for token, proc in list(self._procs.items()):
+                    if proc.poll() is not None:
+                        dead.append((token, proc.returncode))
+                        del self._procs[token]
+            for token, rc in dead:
+                try:
+                    self.head.send({"kind": "worker_died", "token": token,
+                                    "returncode": rc})
+                except protocol.ConnectionClosed:
+                    return
+
+    def _on_head_close(self, conn):
+        # Head gone: tear down this node.
+        self.shutdown()
+
+    def shutdown(self):
+        if self._shutdown.is_set():
+            return
+        self._shutdown.set()
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for p in procs:
+            try:
+                p.kill()
+            except OSError:
+                pass
+        try:
+            self.head.close()
+        except Exception:
+            pass
+        # Clean this node's shared-store namespace.
+        try:
+            from .object_store import SharedObjectStore
+            SharedObjectStore(
+                f"{self.session_name}_{self.node_id}").cleanup_session()
+        except Exception:
+            pass
+
+    def wait(self):
+        self._shutdown.wait()
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--head-addr", required=True)
+    parser.add_argument("--node-id", required=True)
+    parser.add_argument("--resources", default='{"CPU": 1}')
+    parser.add_argument("--session-dir", required=True)
+    parser.add_argument("--session-name", required=True)
+    args = parser.parse_args()
+    logging.basicConfig(level=os.environ.get("RAY_TPU_LOG_LEVEL", "WARNING"))
+    agent = NodeAgent(args.head_addr, args.node_id,
+                      json.loads(args.resources), args.session_dir,
+                      args.session_name)
+    agent.wait()
+    # Give the final worker_died notifications a beat to flush.
+    time.sleep(0.1)
+
+
+if __name__ == "__main__":
+    main()

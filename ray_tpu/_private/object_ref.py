@@ -14,7 +14,8 @@ from .ids import ObjectID
 class ObjectRef:
     __slots__ = ("id", "owner_addr", "size_hint")
 
-    def __init__(self, oid: ObjectID, owner_addr: str = "", size_hint: int = 0):
+    def __init__(self, oid: ObjectID, owner_addr: str = "",
+                 size_hint: int = 0):
         self.id = oid
         self.owner_addr = owner_addr
         self.size_hint = size_hint

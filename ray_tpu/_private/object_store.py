@@ -10,9 +10,9 @@ and large objects in the plasma daemon (mmap shared memory, zero-copy reads,
 - `SharedObjectStore`: objects are files under /dev/shm, one per object,
   named `raytpu_<session>_<object hex>`, written+sealed by the creating
   process and mmap'd read-only by readers (zero-copy numpy views). Sealing is
-  atomic via a rename from a `.tmp` name. This is deliberately daemonless for
-  the Python tier; the native C++ daemon (src/store/) adds eviction and
-  capacity accounting on the same layout.
+  atomic via a rename from a `.tmp` name. Deliberately daemonless: sealing
+  and reading need no broker, and the store name is namespaced per node so
+  simulated multi-node clusters on one machine get distinct stores.
 """
 
 from __future__ import annotations
@@ -124,6 +124,47 @@ class SharedObjectStore:
         meta, buffers, total = serialization.serialize(value)
         self.create_and_seal(oid, meta, buffers, total)
         return total
+
+    def put_blob(self, oid: ObjectID, parts) -> None:
+        """Seal an already-serialized blob — bytes or an iterable of byte
+        chunks (inter-node transfer landing: the receiving node writes the
+        wire bytes straight into its local store; readers then mmap it
+        like any locally-created object). The tmp name is unique per
+        writer so concurrent fetchers of the same object can't corrupt
+        each other's seal."""
+        path = self._path(oid)
+        tmp = f"{path}.tmp{os.getpid()}-{os.urandom(2).hex()}"
+        if isinstance(parts, (bytes, bytearray, memoryview)):
+            parts = (parts,)
+        with open(tmp, "wb") as f:
+            for p in parts:
+                f.write(p)
+        os.rename(tmp, path)
+
+    def blob_size(self, oid: ObjectID) -> Optional[int]:
+        try:
+            return os.stat(self._path(oid)).st_size
+        except FileNotFoundError:
+            return None
+
+    def read_blob_chunks(self, oid: ObjectID, chunk_size: int):
+        """Yield a sealed object's serialized bytes in `chunk_size` pieces
+        without materializing the whole blob (inter-node transfer source;
+        reference: ObjectManager chunk reads from plasma)."""
+        with open(self._path(oid), "rb") as f:
+            while True:
+                part = f.read(chunk_size)
+                if not part:
+                    return
+                yield part
+
+    def read_blob(self, oid: ObjectID) -> Optional[bytes]:
+        """Raw serialized bytes of a sealed object (small-object path)."""
+        try:
+            with open(self._path(oid), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
 
     # -- reader side -----------------------------------------------------
     def contains(self, oid: ObjectID) -> bool:

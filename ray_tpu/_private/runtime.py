@@ -43,6 +43,10 @@ from .task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK, NORMAL_TASK, ArgSpec,
 
 logger = logging.getLogger(__name__)
 
+# Inter-node transfers stream in chunks of this size (reference: the
+# ObjectManager's chunked Push/Pull, `object_manager.h:183-189`).
+OBJECT_CHUNK_SIZE = 8 * 1024 * 1024
+
 
 class _Cell:
     """Memory-store slot: raw serialized bytes, a decoded value, a pointer
@@ -83,18 +87,31 @@ class Runtime:
     """One per process. `role` is "driver" or "worker"."""
 
     def __init__(self, session_dir: str, session_name: str, head_sock: str,
-                 role: str, job_id: Optional[JobID] = None):
+                 role: str, job_id: Optional[JobID] = None,
+                 node_id: str = ""):
         self.role = role
         self.session_dir = session_dir
         self.session_name = session_name
-        sock_dir = os.path.join(session_dir, "sock")
-        os.makedirs(sock_dir, exist_ok=True)
-        self.addr = os.path.join(
-            sock_dir, f"{role}-{os.getpid()}-{os.urandom(3).hex()}.sock")
+        self.node_id = node_id or os.environ.get("RAY_TPU_NODE_ID", "node0")
+        # In a multi-node session (head reached over TCP) every process
+        # serves on TCP so peers on other nodes can dial it; single-node
+        # sessions stay on Unix sockets.
+        if protocol.is_tcp(head_sock):
+            self.addr = "tcp://127.0.0.1:0"  # resolved after bind
+        else:
+            sock_dir = os.path.join(session_dir, "sock")
+            os.makedirs(sock_dir, exist_ok=True)
+            self.addr = os.path.join(
+                sock_dir, f"{role}-{os.getpid()}-{os.urandom(3).hex()}.sock")
         self.job_id = job_id or JobID.generate()
 
         self.memory = MemoryStore()
-        self.shm = SharedObjectStore(session_name)
+        # Store namespaced per node: workers on one node share it; peers on
+        # other nodes go through the transfer path (get_object/chunks).
+        self.shm = SharedObjectStore(f"{session_name}_{self.node_id}")
+        # In-flight inbound chunked transfers: oid -> {total, chunks}.
+        self._chunk_buf: Dict[ObjectID, dict] = {}
+        self._chunk_lock = threading.Lock()
 
         self._conns: Dict[str, protocol.Connection] = {}
         self._conns_lock = threading.Lock()
@@ -126,9 +143,13 @@ class Runtime:
 
         self.server = protocol.Server(
             self.addr, self._handle, on_close=self._on_peer_close)
+        self.addr = self.server.path  # ephemeral tcp port resolved
         self.head = protocol.connect(
             head_sock, self.addr, self._handle,
-            hello_extra={"role": role, "pid": os.getpid()},
+            hello_extra={"role": role, "pid": os.getpid(),
+                         "node_id": self.node_id,
+                         "token": os.environ.get(
+                             "RAY_TPU_WORKER_TOKEN", "")},
             on_close=self._on_head_close)
 
         if role == "worker":
@@ -207,7 +228,8 @@ class Runtime:
             try:
                 conn = self._get_conn(ref.owner_addr)
                 reply = conn.request(
-                    {"kind": "get_object", "object_id": ref.id}, timeout=60)
+                    {"kind": "get_object", "object_id": ref.id,
+                     "node_id": self.node_id}, timeout=60)
             except (protocol.ConnectionClosed, FileNotFoundError,
                     ConnectionRefusedError):
                 if not self.shm.contains(ref.id):
@@ -222,12 +244,19 @@ class Runtime:
             status = reply["status"]
             if status == "inline":
                 self.memory.put(ref.id, _Cell("raw", reply["data"]))
+            elif status == "blob":
+                # Cross-node single-message transfer: land the serialized
+                # bytes in OUR shared store so same-node peers share it.
+                self.shm.put_blob(ref.id, reply["data"])
+                self.memory.put(ref.id, _Cell("shm"))
             elif status == "shm":
                 self.memory.put(ref.id, _Cell("shm"))
             elif status == "lost":
                 self.memory.put(ref.id, _Cell("error", ObjectLostError(
                     f"object {ref.id.hex()[:16]} was lost")))
             # 'pending': owner will push_result when sealed.
+            # 'chunked': object_chunk messages follow on this connection;
+            # the chunk handler seals into the local store when complete.
         finally:
             self._fetching.discard(ref.id)
 
@@ -309,7 +338,8 @@ class Runtime:
             function_key=function_key, args=a, kwargs=kw,
             num_returns=num_returns,
             resources=resources if resources is not None else {"CPU": 1.0},
-            caller_addr=self.addr, max_retries=max_retries, name=name)
+            caller_addr=self.addr, caller_node=self.node_id,
+            max_retries=max_retries, name=name)
         self.head.send({"kind": "submit_task", "spec": spec})
         return [ObjectRef(oid, self.addr) for oid in spec.return_ids()]
 
@@ -323,7 +353,8 @@ class Runtime:
             kind=ACTOR_CREATION_TASK, function_key=class_key, args=a,
             kwargs=kw, num_returns=0,
             resources=resources if resources is not None else {},
-            caller_addr=self.addr, actor_id=actor_id,
+            caller_addr=self.addr, caller_node=self.node_id,
+            actor_id=actor_id,
             max_restarts=max_restarts, max_concurrency=max_concurrency,
             is_asyncio=is_asyncio, name=name,
             env_vars={str(k): str(v) for k, v in (env_vars or {}).items()})
@@ -346,6 +377,7 @@ class Runtime:
             task_id=TaskID.generate(), job_id=self.job_id, kind=ACTOR_TASK,
             method_name=method_name, args=a, kwargs=kw,
             num_returns=num_returns, caller_addr=self.addr,
+            caller_node=self.node_id,
             actor_id=actor_id, actor_seq=seq, name=name)
         with self._pending_lock:
             self._pending_to_addr.setdefault(addr, {})[spec.task_id] = spec
@@ -454,6 +486,8 @@ class Runtime:
             self._task_queue.put(msg["spec"])
         elif kind == "push_task":
             self._on_push_task(msg["spec"])
+        elif kind == "object_chunk":
+            self._on_object_chunk(msg)
         elif kind == "publish":
             self._on_publish(msg)
         elif kind == "shutdown":
@@ -478,8 +512,12 @@ class Runtime:
         # Forward to any borrower that asked before we had it.
         with self._waiters_lock:
             waiters = self._object_waiters.pop(oid, ())
-        for addr in waiters:
+        for addr, node in waiters:
             try:
+                if msg.get("in_shm") and node != self.node_id:
+                    # The borrower can't see our shared store: stream the
+                    # sealed bytes ahead of the (ordered) push_result.
+                    self._send_shm_to(addr, oid)
                 self._get_conn(addr).send(msg)
             except (protocol.ConnectionClosed, FileNotFoundError,
                     ConnectionRefusedError):
@@ -487,6 +525,7 @@ class Runtime:
 
     def _on_get_object(self, conn: protocol.Connection, msg: dict):
         oid: ObjectID = msg["object_id"]
+        same_node = msg.get("node_id", self.node_id) == self.node_id
         entry = self.memory.get_if_exists(oid)
         if entry is not None:
             cell: _Cell = entry.value
@@ -495,22 +534,74 @@ class Runtime:
             elif cell.kind == "value":
                 try:
                     data = serialization.dumps(cell.payload)
-                except Exception as e:  # unpicklable cached value
+                except Exception:  # unpicklable cached value
                     conn.reply(msg, status="lost")
                     return
                 conn.reply(msg, status="inline", data=data)
             elif cell.kind == "shm":
-                conn.reply(msg, status="shm")
+                if same_node:
+                    conn.reply(msg, status="shm")
+                else:
+                    self._reply_blob(conn, msg, oid)
             else:  # error — propagate as lost with the error attached
                 conn.reply(msg, status="error", error=cell.payload)
             return
         if self.shm.contains(oid):
-            conn.reply(msg, status="shm")
+            if same_node:
+                conn.reply(msg, status="shm")
+            else:
+                self._reply_blob(conn, msg, oid)
             return
         # Not here yet: if we own it (a pending task result), promise a push.
         with self._waiters_lock:
-            self._object_waiters.setdefault(oid, set()).add(conn.peer_addr)
+            self._object_waiters.setdefault(oid, set()).add(
+                (conn.peer_addr, msg.get("node_id", self.node_id)))
         conn.reply(msg, status="pending")
+
+    def _reply_blob(self, conn: protocol.Connection, msg: dict,
+                    oid: ObjectID):
+        """Ship a shared-store object to a peer on another node: one
+        message when small, a chunk stream read incrementally from the
+        sealed file when large — the whole blob is never materialized
+        (reference: ObjectManager chunked Push, `object_manager.h:183`)."""
+        size = self.shm.blob_size(oid)
+        if size is None:
+            conn.reply(msg, status="lost")
+            return
+        if size <= OBJECT_CHUNK_SIZE:
+            blob = self.shm.read_blob(oid)
+            if blob is None:
+                conn.reply(msg, status="lost")
+                return
+            conn.reply(msg, status="blob", data=blob)
+            return
+        num = (size + OBJECT_CHUNK_SIZE - 1) // OBJECT_CHUNK_SIZE
+        conn.reply(msg, status="chunked", total=size, num_chunks=num)
+
+        def stream():
+            try:
+                for i, part in enumerate(self.shm.read_blob_chunks(
+                        oid, OBJECT_CHUNK_SIZE)):
+                    conn.send({"kind": "object_chunk", "object_id": oid,
+                               "index": i, "num_chunks": num, "data": part})
+            except protocol.ConnectionClosed:
+                pass
+        threading.Thread(target=stream, daemon=True,
+                         name="object-chunk-send").start()
+
+    def _on_object_chunk(self, msg: dict):
+        oid: ObjectID = msg["object_id"]
+        with self._chunk_lock:
+            buf = self._chunk_buf.setdefault(
+                oid, {"num": msg["num_chunks"], "parts": {}})
+            buf["parts"][msg["index"]] = msg["data"]
+            done = len(buf["parts"]) == buf["num"]
+            if done:
+                parts = [buf["parts"][i] for i in range(buf["num"])]
+                del self._chunk_buf[oid]
+        if done:
+            self.shm.put_blob(oid, parts)
+            self.memory.put(oid, _Cell("shm"))
 
     def _on_publish(self, msg: dict):
         channel = msg["channel"]
@@ -548,7 +639,9 @@ class Runtime:
         kwargs = {k: one(v) for k, v in spec.kwargs.items()}
         return args, kwargs
 
-    def _push_value(self, addr: str, oid: ObjectID, value=None, error=None):
+    def _push_value(self, addr: str, oid: ObjectID, value=None, error=None,
+                    node: str = ""):
+        same_node = node in ("", self.node_id)
         msg = {"kind": "push_result", "object_id": oid}
         if error is not None:
             import pickle as _stdpickle
@@ -568,14 +661,53 @@ class Runtime:
                 msg["error"] = TaskError.from_exception(e, "serializing result")
                 self._send_result(addr, msg)
                 return
-            if total > INLINE_OBJECT_MAX:
+            if total > INLINE_OBJECT_MAX and same_node:
                 self.shm.create_and_seal(oid, meta, buffers, total)
+                msg["in_shm"] = True
+            elif total > INLINE_OBJECT_MAX:
+                # Cross-node result: stream the blob to the owner's node,
+                # landing it in THEIR shared store; the ordered push_result
+                # behind the chunks then finds it sealed there.
+                out = bytearray(total)
+                serialization.write_blob(memoryview(out), meta, buffers)
+                self._send_blob_to(addr, oid, bytes(out))
                 msg["in_shm"] = True
             else:
                 out = bytearray(total)
                 serialization.write_blob(memoryview(out), meta, buffers)
                 msg["data"] = bytes(out)
         self._send_result(addr, msg)
+
+    def _send_blob_to(self, addr: str, oid: ObjectID, blob: bytes):
+        num = max(1, (len(blob) + OBJECT_CHUNK_SIZE - 1)
+                  // OBJECT_CHUNK_SIZE)
+        try:
+            conn = self._get_conn(addr)
+            for i in range(num):
+                part = blob[i * OBJECT_CHUNK_SIZE:
+                            (i + 1) * OBJECT_CHUNK_SIZE]
+                conn.send({"kind": "object_chunk", "object_id": oid,
+                           "index": i, "num_chunks": num, "data": part})
+        except (protocol.ConnectionClosed, FileNotFoundError,
+                ConnectionRefusedError):
+            logger.warning("could not stream object %s to %s", oid, addr)
+
+    def _send_shm_to(self, addr: str, oid: ObjectID):
+        """Stream a sealed shared-store object to a cross-node peer,
+        reading the file incrementally."""
+        size = self.shm.blob_size(oid)
+        if size is None:
+            return
+        num = max(1, (size + OBJECT_CHUNK_SIZE - 1) // OBJECT_CHUNK_SIZE)
+        try:
+            conn = self._get_conn(addr)
+            for i, part in enumerate(
+                    self.shm.read_blob_chunks(oid, OBJECT_CHUNK_SIZE)):
+                conn.send({"kind": "object_chunk", "object_id": oid,
+                           "index": i, "num_chunks": num, "data": part})
+        except (protocol.ConnectionClosed, FileNotFoundError,
+                ConnectionRefusedError):
+            logger.warning("could not stream object %s to %s", oid, addr)
 
     def _send_result(self, addr: str, msg: dict):
         if addr == self.addr:
@@ -601,18 +733,21 @@ class Runtime:
                     spec.actor_id.hex() if spec.actor_id else "",
                     "actor exited via exit_actor()")
                 for oid in spec.return_ids():
-                    self._push_value(spec.caller_addr, oid, error=err)
+                    self._push_value(spec.caller_addr, oid, error=err,
+                                 node=spec.caller_node)
                 time.sleep(0.05)
                 os._exit(0)
             # A normal task calling sys.exit(): report it, keep the worker.
             err = TaskError(e, "", spec.describe() + " called sys.exit()")
             for oid in spec.return_ids():
-                self._push_value(spec.caller_addr, oid, error=err)
+                self._push_value(spec.caller_addr, oid, error=err,
+                                 node=spec.caller_node)
         except BaseException as e:  # noqa: BLE001 — report, don't die
             err = e if isinstance(e, TaskError) else \
                 TaskError.from_exception(e, spec.describe())
             for oid in spec.return_ids():
-                self._push_value(spec.caller_addr, oid, error=err)
+                self._push_value(spec.caller_addr, oid, error=err,
+                                 node=spec.caller_node)
 
     def _deliver_result(self, spec: TaskSpec, result):
         n = spec.num_returns
@@ -627,7 +762,8 @@ class Runtime:
                     ValueError(f"task declared num_returns={n} but returned "
                                f"{len(values)} values"), "", spec.describe())
         for oid, val in zip(spec.return_ids(), values):
-            self._push_value(spec.caller_addr, oid, value=val)
+            self._push_value(spec.caller_addr, oid, value=val,
+                             node=spec.caller_node)
 
     def _execute_normal(self, spec: TaskSpec):
         try:
@@ -687,7 +823,8 @@ class Runtime:
     def _dispatch_actor_task(self, actor: ActorState, spec: TaskSpec):
         if spec.method_name == "__ray_terminate__":
             def terminate():
-                self._push_value(spec.caller_addr, spec.return_ids()[0], value=None)
+                self._push_value(spec.caller_addr, spec.return_ids()[0],
+                                 value=None, node=spec.caller_node)
                 time.sleep(0.1)
                 os._exit(0)
             threading.Thread(target=terminate, daemon=True).start()
@@ -720,7 +857,8 @@ class Runtime:
             except BaseException as e:
                 err = TaskError.from_exception(e, spec.describe())
                 for oid in spec.return_ids():
-                    self._push_value(spec.caller_addr, oid, error=err)
+                    self._push_value(spec.caller_addr, oid, error=err,
+                                 node=spec.caller_node)
 
     # ==================================================================
     def run_worker_loop(self):

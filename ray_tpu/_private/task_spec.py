@@ -45,6 +45,9 @@ class TaskSpec:
     # objects (reference ownership model: the caller's CoreWorker owns
     # direct-call results).
     caller_addr: str = ""
+    # Node the caller lives on — an executor on a different node must ship
+    # results over the wire instead of pointing at its local shared store.
+    caller_node: str = ""
     actor_id: Optional[ActorID] = None
     # Per (caller, actor) sequence number for ordered actor task streams
     # (reference: direct_actor_transport.h sequence_number).

@@ -1,0 +1,126 @@
+"""In-process multi-node cluster for tests.
+
+Parity: `python/ray/cluster_utils.py:12` — the reference's single most
+load-bearing test trick (SURVEY.md §4.2): boot N per-node agents on one
+machine against one head so distributed scheduling, spillback, object
+transfer, and node-failure handling run in CI with no real cluster.
+
+Here the head (with its TCP plane enabled) runs in the driver process and
+each added node is a `node_agent.py` subprocess with its own node id,
+resource vector, and node-scoped shared-memory store — so cross-"node"
+object access exercises the real chunked transfer path rather than
+leaking through one shared /dev/shm namespace.
+
+    cluster = Cluster(head_resources={"CPU": 2})
+    nodeA = cluster.add_node(resources={"CPU": 4})
+    ...
+    cluster.remove_node(nodeA)   # SIGKILL: simulates node failure
+    cluster.shutdown()
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ._private import node as _node
+from ._private import worker_state as _ws
+
+
+class NodeHandle:
+    def __init__(self, node_id: str, proc: subprocess.Popen):
+        self.node_id = node_id
+        self.proc = proc
+
+
+class Cluster:
+    def __init__(self, head_resources: Optional[Dict[str, float]] = None,
+                 worker_env: Optional[dict] = None):
+        if _ws.get_runtime_or_none() is not None:
+            raise RuntimeError(
+                "ray_tpu is already initialized; Cluster() must create the "
+                "head itself")
+        self.node = _node.init(
+            resources=head_resources or {"CPU": 1.0},
+            worker_env=worker_env, enable_tcp=True)
+        self.head_addr = self.node.head.tcp_addr
+        self._nodes: List[NodeHandle] = []
+        self._counter = 0
+
+    # ------------------------------------------------------------------
+    def add_node(self, resources: Optional[Dict[str, float]] = None,
+                 node_id: Optional[str] = None,
+                 wait: bool = True) -> NodeHandle:
+        self._counter += 1
+        node_id = node_id or f"node{self._counter}"
+        session_dir = os.path.join(self.node.session_dir,
+                                   f"node-{node_id}")
+        os.makedirs(session_dir, exist_ok=True)
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p] +
+            ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu._private.node_agent",
+             "--head-addr", self.head_addr,
+             "--node-id", node_id,
+             "--resources", json.dumps(resources or {"CPU": 1.0}),
+             "--session-dir", session_dir,
+             "--session-name", self.node.session_name],
+            env=env)
+        handle = NodeHandle(node_id, proc)
+        self._nodes.append(handle)
+        if wait:
+            self.wait_for_nodes(len(self._nodes) + 1)
+        return handle
+
+    def remove_node(self, handle: NodeHandle, graceful: bool = False):
+        """Kill a node agent. `graceful=False` SIGKILLs the agent AND its
+        workers (simulating machine loss, reference:
+        `cluster_utils.py:116`)."""
+        if graceful:
+            handle.proc.terminate()
+        else:
+            handle.proc.kill()
+        handle.proc.wait(timeout=10)
+        if not graceful:
+            self._kill_node_workers(handle.node_id)
+        self._nodes = [n for n in self._nodes if n is not handle]
+
+    def _kill_node_workers(self, node_id: str):
+        # The head learns of the node death via the agent connection
+        # closing; here we also kill the node's orphaned worker processes
+        # (on a real machine loss they die with the host).
+        import signal
+        head = self.node.head
+        with head._lock:
+            pids = [w.pid for w in head._spawned.values()
+                    if w.node_id == node_id and w.pid and w.proc is None]
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def wait_for_nodes(self, n: int, timeout: float = 30.0):
+        """Block until the head sees `n` alive nodes (head node included)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            info = self.node.runtime.cluster_info()
+            if len(info["nodes"]) >= n:
+                return
+            time.sleep(0.05)
+        raise TimeoutError(
+            f"cluster did not reach {n} nodes within {timeout}s")
+
+    def shutdown(self):
+        for h in list(self._nodes):
+            try:
+                self.remove_node(h)
+            except Exception:
+                pass
+        _node.shutdown()
