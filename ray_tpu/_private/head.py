@@ -319,6 +319,13 @@ class HeadServer:
             info = self._actors.get(actor_id)
             if info is None:
                 return
+            # Stale ready from an incarnation whose worker already died
+            # (death handler ran first): ignore — accepting it would
+            # resurrect a DEAD/RESTARTING actor at a dead address and
+            # double-release the creation lease.
+            w = self._workers.get(msg["addr"])
+            if w is None or w._reaped or w.actor_id != actor_id:
+                return
             info.state = ALIVE
             info.addr = msg["addr"]
             self._inflight.pop(info.spec.task_id, None)
@@ -439,11 +446,22 @@ class HeadServer:
     # ------------------------------------------------------------------
     # scheduling (lease grant) — runs under self._lock
     # ------------------------------------------------------------------
-    def _pick_node_locked(self, spec: TaskSpec) -> Optional[NodeInfo]:
+    def _pick_node_locked(self, spec: TaskSpec,
+                          planned_get=None) -> Optional[NodeInfo]:
         """First-fit across nodes, local node first (the remote fit is the
-        reference's spillback, `scheduling_policy.h:35`)."""
+        reference's spillback, `scheduling_policy.h:35`). `planned_get`
+        supplies in-drain tentative commitments to subtract."""
         for node in self._nodes.values():
-            if node.alive and node.fits(spec.resources):
+            if not node.alive:
+                continue
+            planned = planned_get(node.node_id) if planned_get else None
+            if planned:
+                ok = all(node.available.get(k, 0.0)
+                         - planned.get(k, 0.0) + 1e-9 >= v
+                         for k, v in spec.resources.items())
+            else:
+                ok = node.fits(spec.resources)
+            if ok:
                 return node
         return None
 
@@ -472,9 +490,14 @@ class HeadServer:
 
     def _drain_pending_locked(self, remaining: deque,
                               need_worker: Dict[str, int]):
+        # Tentative per-node resource commitments for queued tasks that
+        # will get a fresh pool worker: caps pool growth at what the
+        # node's resource vector can actually run concurrently (a 100-task
+        # fan-out on a 4-CPU node spawns 4 workers, not 100).
+        planned: Dict[str, Dict[str, float]] = {}
         while self._pending:
             spec = self._pending.popleft()
-            node = self._pick_node_locked(spec)
+            node = self._pick_node_locked(spec, planned.get)
             if node is None:
                 remaining.append(spec)
                 continue
@@ -517,7 +540,12 @@ class HeadServer:
                 else:
                     remaining.append(spec)
                     # Pool growth happens after the drain (reference:
-                    # WorkerPool starts workers on demand for leases).
+                    # WorkerPool starts workers on demand for leases);
+                    # commit this task's resources tentatively so later
+                    # queued tasks don't over-count the deficit.
+                    p = planned.setdefault(node.node_id, {})
+                    for k, v in spec.resources.items():
+                        p[k] = p.get(k, 0.0) + v
                     need_worker[node.node_id] = \
                         need_worker.get(node.node_id, 0) + 1
 

@@ -71,6 +71,30 @@ class MemoryStore:
                 self._cv.wait(remaining)
             return self._objects[oid]
 
+    def wait_threshold(self, oids, num: int, timeout: Optional[float],
+                       extra_ready=None) -> list:
+        """Block until >= `num` of `oids` are ready, where ready means
+        present here OR `extra_ready(oid)` (e.g. sealed in the shared
+        store). Event-driven on this store's condition variable — every
+        put() wakes the waiter — with a coarse periodic re-check for
+        out-of-band shared-store seals. Returns the ready list (may be
+        shorter than `num` on timeout)."""
+        deadline = None if timeout is None else (timeout + _now())
+        with self._cv:
+            while True:
+                ready = [o for o in oids
+                         if o in self._objects
+                         or (extra_ready is not None and extra_ready(o))]
+                if len(ready) >= num:
+                    return ready
+                remaining = None if deadline is None else deadline - _now()
+                if remaining is not None and remaining <= 0:
+                    return ready
+                # 50 ms cap: shared-store seals by same-node peers don't
+                # signal this cv.
+                self._cv.wait(0.05 if remaining is None
+                              else min(remaining, 0.05))
+
     def delete(self, oid: ObjectID) -> None:
         with self._cv:
             self._objects.pop(oid, None)

@@ -139,6 +139,9 @@ class Runtime:
         # Worker-side execution state.
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
         self._actor: Optional[ActorState] = None
+        # Actor calls that arrived before __init__ finished.
+        self._pre_actor_tasks: List[TaskSpec] = []
+        self._pre_actor_lock = threading.Lock()
         self._shutdown_event = threading.Event()
 
         self.server = protocol.Server(
@@ -273,18 +276,19 @@ class Runtime:
                 self._fetching.add(r.id)
                 threading.Thread(target=self._request_from_owner, args=(r,),
                                  daemon=True).start()
-        sleep = 0.0005
-        while True:
-            ready = [r for r in refs
-                     if self.memory.contains(r.id) or self.shm.contains(r.id)]
-            timed_out = deadline is not None and time.monotonic() >= deadline
-            if len(ready) >= num_returns or timed_out:
-                ready = ready[:num_returns]
-                ready_set = set(ready)
-                not_ready = [r for r in refs if r not in ready_set]
-                return ready, not_ready
-            time.sleep(sleep)
-            sleep = min(sleep * 2, 0.01)
+        # Event-driven: every push_result put() wakes the memory-store cv
+        # (reference: CoreWorker::Wait blocks on store callbacks rather
+        # than polling, core_worker.cc:258).
+        by_id = {r.id: r for r in refs}
+        remaining = None if deadline is None \
+            else max(0.0, deadline - time.monotonic())
+        ready_ids = self.memory.wait_threshold(
+            list(by_id), num_returns, remaining,
+            extra_ready=self.shm.contains)
+        ready = [by_id[i] for i in ready_ids][:num_returns]
+        ready_set = set(ready)
+        not_ready = [r for r in refs if r not in ready_set]
+        return ready, not_ready
 
     def free(self, refs: List[ObjectRef]):
         for r in refs:
@@ -580,10 +584,9 @@ class Runtime:
 
         def stream():
             try:
-                for i, part in enumerate(self.shm.read_blob_chunks(
-                        oid, OBJECT_CHUNK_SIZE)):
-                    conn.send({"kind": "object_chunk", "object_id": oid,
-                               "index": i, "num_chunks": num, "data": part})
+                self._stream_chunks(
+                    conn, oid,
+                    self.shm.read_blob_chunks(oid, OBJECT_CHUNK_SIZE), num)
             except protocol.ConnectionClosed:
                 pass
         threading.Thread(target=stream, daemon=True,
@@ -678,16 +681,21 @@ class Runtime:
                 msg["data"] = bytes(out)
         self._send_result(addr, msg)
 
+    @staticmethod
+    def _stream_chunks(conn, oid: ObjectID, parts, num: int):
+        """Send an object's serialized bytes as ordered object_chunk
+        messages (single protocol point for all three transfer paths)."""
+        for i, part in enumerate(parts):
+            conn.send({"kind": "object_chunk", "object_id": oid,
+                       "index": i, "num_chunks": num, "data": part})
+
     def _send_blob_to(self, addr: str, oid: ObjectID, blob: bytes):
         num = max(1, (len(blob) + OBJECT_CHUNK_SIZE - 1)
                   // OBJECT_CHUNK_SIZE)
+        parts = (blob[i * OBJECT_CHUNK_SIZE:(i + 1) * OBJECT_CHUNK_SIZE]
+                 for i in range(num))
         try:
-            conn = self._get_conn(addr)
-            for i in range(num):
-                part = blob[i * OBJECT_CHUNK_SIZE:
-                            (i + 1) * OBJECT_CHUNK_SIZE]
-                conn.send({"kind": "object_chunk", "object_id": oid,
-                           "index": i, "num_chunks": num, "data": part})
+            self._stream_chunks(self._get_conn(addr), oid, parts, num)
         except (protocol.ConnectionClosed, FileNotFoundError,
                 ConnectionRefusedError):
             logger.warning("could not stream object %s to %s", oid, addr)
@@ -700,11 +708,9 @@ class Runtime:
             return
         num = max(1, (size + OBJECT_CHUNK_SIZE - 1) // OBJECT_CHUNK_SIZE)
         try:
-            conn = self._get_conn(addr)
-            for i, part in enumerate(
-                    self.shm.read_blob_chunks(oid, OBJECT_CHUNK_SIZE)):
-                conn.send({"kind": "object_chunk", "object_id": oid,
-                           "index": i, "num_chunks": num, "data": part})
+            self._stream_chunks(
+                self._get_conn(addr), oid,
+                self.shm.read_blob_chunks(oid, OBJECT_CHUNK_SIZE), num)
         except (protocol.ConnectionClosed, FileNotFoundError,
                 ConnectionRefusedError):
             logger.warning("could not stream object %s to %s", oid, addr)
@@ -792,7 +798,12 @@ class Runtime:
                             "error": traceback.format_exc()})
             time.sleep(0.2)
             os._exit(1)
-        self._actor = ActorState(spec, instance)
+        with self._pre_actor_lock:
+            self._actor = ActorState(spec, instance)
+            parked = self._pre_actor_tasks
+            self._pre_actor_tasks = []
+        for s in parked:
+            self._on_push_task(s)
         self.head.send({"kind": "actor_ready", "actor_id": spec.actor_id,
                         "addr": self.addr})
 
@@ -800,14 +811,16 @@ class Runtime:
     def _on_push_task(self, spec: TaskSpec):
         actor = self._actor
         if actor is None:
-            # Creation still in progress; requeue briefly.
-            def later():
-                for _ in range(600):
-                    if self._actor is not None:
-                        self._on_push_task(spec)
-                        return
-                    time.sleep(0.05)
-            threading.Thread(target=later, daemon=True).start()
+            # Creation still in progress: park the call; the creation
+            # path drains this queue the moment the instance exists
+            # (reference: the receiver-side SchedulingQueue holds tasks
+            # behind dependency waits, direct_actor_transport.h:170 —
+            # no polling threads).
+            with self._pre_actor_lock:
+                if self._actor is None:
+                    self._pre_actor_tasks.append(spec)
+                    return
+            self._on_push_task(spec)
             return
         with actor.lock:
             stream = actor.streams.setdefault(

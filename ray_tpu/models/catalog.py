@@ -81,13 +81,14 @@ def get_model(obs_space, num_outputs: int, model_config: dict = None):
     cfg = dict(MODEL_DEFAULTS)
     cfg.update(model_config or {})
     if cfg["use_lstm"]:
-        # LSTMNetwork takes (obs[B,T], state, reset_mask); the feedforward
-        # JaxPolicy can't drive it — recurrent rollouts/training need the
-        # recurrent policy path (rnn_sequencing parity), not silent misuse.
-        raise NotImplementedError(
-            "use_lstm=True requires a recurrent policy (construct "
-            "LSTMNetwork via make_model= and handle state explicitly); "
-            "feedforward JaxPolicy cannot drive it")
+        # Recurrent trunk: JaxPolicy drives it through the recurrent path
+        # (state threading in the sampler + sequence-major training,
+        # parity: `rllib/policy/rnn_sequencing.py` + `lstm_v1.py`).
+        return LSTMNetwork(
+            num_outputs=num_outputs,
+            cell_size=cfg["lstm_cell_size"],
+            hiddens=tuple(cfg["fcnet_hiddens"]),
+            activation=cfg["fcnet_activation"])
     if is_image_space(obs_space):
         filters = cfg["conv_filters"] or ((32, 8, 4), (64, 4, 2), (64, 3, 1))
         return VisionNetwork(

@@ -1,0 +1,128 @@
+"""Core runtime microbenchmarks.
+
+Parity: `python/ray/ray_perf.py:79` — tasks/s, actor calls/s, put/get
+latency against the live runtime. Run:
+
+    python -m ray_tpu.ray_perf [--quick]
+
+Each benchmark reports mean throughput or latency over its measurement
+window. These numbers gate scheduler/transport overhead: APEX/IMPALA
+sampling pushes thousands of calls/s through exactly these paths.
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+import ray_tpu
+
+
+def timeit(name: str, fn, multiplier: int = 1, rounds: int = 3):
+    """Mirrors ray_perf.py's timeit: warmup + best-of-rounds ops/s."""
+    fn()  # warmup
+    best = 0.0
+    for _ in range(rounds):
+        t0 = time.perf_counter()
+        n = fn()
+        dt = time.perf_counter() - t0
+        best = max(best, (n * multiplier) / dt)
+    print(f"{name:<40s} {best:>12.1f} ops/s")
+    return best
+
+
+def main(quick: bool = False):
+    ray_tpu.init(num_cpus=4)
+    scale = 1 if quick else 4
+    results = {}
+
+    @ray_tpu.remote
+    def noop():
+        return 0
+
+    @ray_tpu.remote
+    class Actor:
+        def noop(self):
+            return 0
+
+    # -- tasks ----------------------------------------------------------
+    n_tasks = 100 * scale
+
+    def submit_and_get_tasks():
+        ray_tpu.get([noop.remote() for _ in range(n_tasks)])
+        return n_tasks
+
+    results["tasks_per_s"] = timeit("tasks (submit+get, batch)",
+                                    submit_and_get_tasks)
+
+    def sequential_tasks():
+        n = 20 * scale
+        for _ in range(n):
+            ray_tpu.get(noop.remote())
+        return n
+
+    results["seq_tasks_per_s"] = timeit("tasks (sequential round-trip)",
+                                        sequential_tasks)
+
+    # -- actor calls ----------------------------------------------------
+    actor = Actor.remote()
+    ray_tpu.get(actor.noop.remote())
+
+    def actor_calls_sync():
+        n = 50 * scale
+        for _ in range(n):
+            ray_tpu.get(actor.noop.remote())
+        return n
+
+    results["actor_calls_sync_per_s"] = timeit(
+        "actor calls (sync round-trip)", actor_calls_sync)
+
+    def actor_calls_async():
+        n = 200 * scale
+        ray_tpu.get([actor.noop.remote() for _ in range(n)])
+        return n
+
+    results["actor_calls_async_per_s"] = timeit(
+        "actor calls (pipelined)", actor_calls_async)
+
+    # -- object store ---------------------------------------------------
+    small = np.zeros(16, np.float64)          # inline path
+    big = np.zeros(1 << 18, np.float64)       # 2 MB -> shm path
+
+    def put_small():
+        n = 200 * scale
+        for _ in range(n):
+            ray_tpu.put(small)
+        return n
+
+    results["put_small_per_s"] = timeit("put (128 B)", put_small)
+
+    def put_get_big():
+        n = 20 * scale
+        for _ in range(n):
+            ray_tpu.get(ray_tpu.put(big))
+        return n
+
+    results["put_get_2mb_per_s"] = timeit("put+get (2 MB, zero-copy mmap)",
+                                          put_get_big)
+
+    def wait_ready():
+        n = 100 * scale
+        refs = [ray_tpu.put(small) for _ in range(n)]
+        for r in refs:
+            ray_tpu.wait([r], num_returns=1)
+        return n
+
+    results["wait_per_s"] = timeit("wait (ready object)", wait_ready)
+
+    ray_tpu.shutdown()
+    return results
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--quick", action="store_true")
+    args = parser.parse_args()
+    main(quick=args.quick)

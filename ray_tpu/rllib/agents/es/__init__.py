@@ -1,0 +1,4 @@
+from .es import ARS_DEFAULT_CONFIG, ARSTrainer, DEFAULT_CONFIG, ESTrainer
+
+__all__ = ["ARS_DEFAULT_CONFIG", "ARSTrainer", "DEFAULT_CONFIG",
+           "ESTrainer"]

@@ -30,6 +30,10 @@ def make_async_optimizer(workers, config):
 
 
 def validate_config(config):
+    if (config.get("model") or {}).get("use_lstm"):
+        # Recurrent IMPALA trains on the packed fragments themselves:
+        # one fragment = one LSTM sequence.
+        config["_train_seq_len"] = config["rollout_fragment_length"]
     if config["train_batch_size"] % config["rollout_fragment_length"] != 0:
         raise ValueError(
             "train_batch_size must be a multiple of "

@@ -60,12 +60,31 @@ def vtrace_loss(policy, params, batch, rng, loss_state):
     T = cfg["rollout_fragment_length"]
     gamma = cfg["gamma"]
 
-    dist_inputs, values_flat = policy.apply(params, batch[sb.OBS])
-
-    # Bootstrap: value of the observation after each sequence's last step,
-    # under the current (target) policy.
-    new_obs_tb = _time_major(batch[sb.NEW_OBS], T)
-    _, bootstrap_value = policy.apply(params, new_obs_tb[-1])
+    if policy.recurrent:
+        # LSTM scan over the packed [B, T] fragments with per-fragment
+        # initial state and done-driven resets (the reference's IMPALA is
+        # LSTM-first; here the whole recurrent forward fuses into the
+        # V-trace program).
+        dist_bt, val_bt, carry = policy.apply_sequences(params, batch)
+        dist_inputs = dist_bt.reshape(-1, dist_bt.shape[-1])
+        values_flat = val_bt.reshape(-1)
+        # Bootstrap: one more LSTM step from the final carry on each
+        # fragment's last NEW_OBS (reset if that step ended an episode —
+        # its value is then V(s0) of the next episode, matching discount
+        # 0 at the boundary).
+        new_obs = batch[sb.NEW_OBS]
+        B = new_obs.shape[0] // T
+        last_new_obs = new_obs.reshape((B, T) + new_obs.shape[1:])[:, -1]
+        last_done = batch[sb.DONES].reshape(B, T)[:, -1]
+        _, boot_bt, _ = policy.apply(
+            params, last_new_obs[:, None], carry, last_done[:, None])
+        bootstrap_value = boot_bt[:, 0]
+    else:
+        dist_inputs, values_flat = policy.apply(params, batch[sb.OBS])
+        # Bootstrap: value of the observation after each sequence's last
+        # step, under the current (target) policy.
+        new_obs_tb = _time_major(batch[sb.NEW_OBS], T)
+        _, bootstrap_value = policy.apply(params, new_obs_tb[-1])
 
     behaviour_logits = _time_major(batch[sb.ACTION_DIST_INPUTS], T)
     target_logits = _time_major(dist_inputs, T)

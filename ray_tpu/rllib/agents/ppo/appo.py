@@ -1,0 +1,171 @@
+"""APPO: asynchronous PPO (IMPALA architecture + PPO clipped surrogate).
+
+Parity: `rllib/agents/ppo/appo.py` + `appo_policy.py` — the
+AsyncSamplesOptimizer actor/learner split of IMPALA, but the learner
+minimizes the PPO clip objective with V-trace-corrected advantages
+(when `vtrace: True`) or plain GAE otherwise. The TPU learner fuses the
+V-trace scan and the clipped update into one XLA program, exactly like
+the IMPALA learner.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import sample_batch as sb
+from ...policy.jax_policy_template import build_jax_policy
+from ..impala import vtrace
+from ..impala.impala import make_async_optimizer, validate_config
+from ..impala.vtrace_policy import _time_major
+from ..trainer import with_common_config
+from ..trainer_template import build_trainer
+
+DEFAULT_CONFIG = with_common_config({
+    "lr": 0.0005,
+    "gamma": 0.99,
+    "grad_clip": 40.0,
+    "vf_loss_coeff": 0.5,
+    "entropy_coeff": 0.01,
+    "clip_param": 0.4,
+    "vtrace": True,
+    "vtrace_clip_rho_threshold": 1.0,
+    "vtrace_clip_pg_rho_threshold": 1.0,
+    "lambda": 1.0,
+    "rollout_fragment_length": 50,
+    "train_batch_size": 500,
+    "min_iter_time_s": 10,
+    "num_workers": 2,
+    "num_envs_per_worker": 1,
+    "pack_fragments": True,
+    "use_gae": False,
+    "max_sample_requests_in_flight_per_worker": 2,
+    "broadcast_interval": 1,
+    "learner_queue_size": 16,
+    "num_sgd_iter": 1,
+    "sgd_minibatch_size": 0,
+})
+
+
+def appo_loss(policy, params, batch, rng, loss_state):
+    cfg = policy.config
+    if not cfg.get("vtrace", True):
+        return _appo_gae_loss(policy, params, batch, rng, loss_state)
+    T = cfg["rollout_fragment_length"]
+    gamma = cfg["gamma"]
+
+    if policy.recurrent:
+        dist_bt, val_bt, carry = policy.apply_sequences(params, batch)
+        dist_inputs = dist_bt.reshape(-1, dist_bt.shape[-1])
+        values_flat = val_bt.reshape(-1)
+        new_obs = batch[sb.NEW_OBS]
+        B = new_obs.shape[0] // T
+        last_new_obs = new_obs.reshape((B, T) + new_obs.shape[1:])[:, -1]
+        last_done = batch[sb.DONES].reshape(B, T)[:, -1]
+        _, boot_bt, _ = policy.apply(
+            params, last_new_obs[:, None], carry, last_done[:, None])
+        bootstrap_value = boot_bt[:, 0]
+    else:
+        dist_inputs, values_flat = policy.apply(params, batch[sb.OBS])
+        new_obs_tb = _time_major(batch[sb.NEW_OBS], T)
+        _, bootstrap_value = policy.apply(params, new_obs_tb[-1])
+
+    behaviour_logits = _time_major(batch[sb.ACTION_DIST_INPUTS], T)
+    target_logits = _time_major(dist_inputs, T)
+    actions = _time_major(batch[sb.ACTIONS], T)
+    rewards = _time_major(batch[sb.REWARDS], T)
+    dones = _time_major(batch[sb.DONES], T)
+    values = _time_major(values_flat, T)
+    discounts = gamma * (1.0 - dones)
+
+    returns, log_rhos, target_logp = vtrace.from_logits(
+        behaviour_policy_logits=behaviour_logits,
+        target_policy_logits=target_logits,
+        actions=actions,
+        discounts=discounts,
+        rewards=rewards,
+        values=values,
+        bootstrap_value=bootstrap_value,
+        dist_class=policy.dist_class,
+        clip_rho_threshold=cfg["vtrace_clip_rho_threshold"],
+        clip_pg_rho_threshold=cfg["vtrace_clip_pg_rho_threshold"],
+        lambda_=cfg["lambda"])
+    vs = jax.lax.stop_gradient(returns.vs)
+    adv = jax.lax.stop_gradient(returns.pg_advantages)
+
+    # PPO clip on the importance ratio (reference appo_policy.py:
+    # surrogate with clip_param around the behaviour policy).
+    behaviour_logp = policy.dist_class(behaviour_logits).logp(actions)
+    ratio = jnp.exp(target_logp - behaviour_logp)
+    clip_param = cfg["clip_param"]
+    surrogate = jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1.0 - clip_param, 1.0 + clip_param) * adv)
+
+    pi_loss = -jnp.mean(surrogate)
+    delta = values - vs
+    vf_loss = 0.5 * jnp.mean(delta ** 2)
+    entropy = jnp.mean(policy.dist_class(target_logits).entropy())
+
+    total = (pi_loss
+             + cfg["vf_loss_coeff"] * vf_loss
+             - cfg["entropy_coeff"] * entropy)
+    stats = {
+        "total_loss": total,
+        "policy_loss": pi_loss,
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+        "mean_ratio": jnp.mean(ratio),
+        "vtrace_mean_vs": jnp.mean(vs),
+    }
+    return total, stats
+
+
+def _appo_gae_loss(policy, params, batch, rng, loss_state):
+    """vtrace: False — PPO clip on worker-side GAE advantages (reference
+    appo.py routes this through the plain PPO surrogate)."""
+    cfg = policy.config
+    dist_inputs, value = policy.apply_batch(params, batch)
+    dist = policy.dist_class(dist_inputs)
+    logp = dist.logp(batch[sb.ACTIONS])
+    ratio = jnp.exp(logp - batch[sb.ACTION_LOGP])
+    adv = batch[sb.ADVANTAGES]
+    clip_param = cfg["clip_param"]
+    surrogate = jnp.minimum(
+        ratio * adv,
+        jnp.clip(ratio, 1.0 - clip_param, 1.0 + clip_param) * adv)
+    vf_loss = 0.5 * jnp.mean((value - batch[sb.VALUE_TARGETS]) ** 2)
+    entropy = jnp.mean(dist.entropy())
+    total = (-jnp.mean(surrogate)
+             + cfg["vf_loss_coeff"] * vf_loss
+             - cfg["entropy_coeff"] * entropy)
+    stats = {
+        "total_loss": total,
+        "policy_loss": -jnp.mean(surrogate),
+        "vf_loss": vf_loss,
+        "entropy": entropy,
+        "mean_ratio": jnp.mean(ratio),
+    }
+    return total, stats
+
+
+def appo_validate_config(config):
+    if not config.get("vtrace", True):
+        # GAE mode: episode-chunked sampling with worker-side advantage
+        # computation instead of packed fragments.
+        config["pack_fragments"] = False
+        config["use_gae"] = True
+        return
+    validate_config(config)
+
+
+APPOJaxPolicy = build_jax_policy(
+    "APPOJaxPolicy", appo_loss, get_default_config=lambda: DEFAULT_CONFIG)
+
+
+APPOTrainer = build_trainer(
+    name="APPO",
+    default_policy=APPOJaxPolicy,
+    default_config=DEFAULT_CONFIG,
+    make_policy_optimizer=make_async_optimizer,
+    validate_config=appo_validate_config)

@@ -42,7 +42,16 @@ DEFAULT_CONFIG = with_common_config({
 
 def ppo_loss(policy, params, batch, rng, loss_state):
     cfg = policy.config
-    dist_inputs, value = policy.apply(params, batch[sb.OBS])
+    # apply_batch handles the recurrent [B, T] reshape + LSTM scan;
+    # padded rows (seq_mask == 0) are excluded from every mean below.
+    dist_inputs, value = policy.apply_batch(params, batch)
+    mask = batch.get("seq_mask")
+
+    def mmean(x):
+        if mask is None:
+            return jnp.mean(x)
+        return jnp.sum(x * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
     dist = policy.dist_class(dist_inputs)
     old_dist = policy.dist_class(batch[sb.ACTION_DIST_INPUTS])
 
@@ -67,17 +76,17 @@ def ppo_loss(policy, params, batch, rng, loss_state):
     vf_loss = jnp.maximum(vf_err1, vf_err2)
 
     kl_coeff = loss_state.get("kl_coeff", jnp.float32(0.0))
-    total = jnp.mean(
+    total = mmean(
         -surrogate
         + kl_coeff * kl
         + cfg["vf_loss_coeff"] * vf_loss
         - cfg["entropy_coeff"] * entropy)
     stats = {
         "total_loss": total,
-        "policy_loss": -jnp.mean(surrogate),
-        "vf_loss": jnp.mean(vf_loss),
-        "kl": jnp.mean(kl),
-        "entropy": jnp.mean(entropy),
+        "policy_loss": -mmean(surrogate),
+        "vf_loss": mmean(vf_loss),
+        "kl": mmean(kl),
+        "entropy": mmean(entropy),
         "vf_explained_var": explained_variance(v_target, value),
     }
     return total, stats

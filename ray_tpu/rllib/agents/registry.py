@@ -61,6 +61,21 @@ def _sac():
     return SACTrainer
 
 
+def _appo():
+    from .ppo.appo import APPOTrainer
+    return APPOTrainer
+
+
+def _es():
+    from .es import ESTrainer
+    return ESTrainer
+
+
+def _ars():
+    from .es import ARSTrainer
+    return ARSTrainer
+
+
 ALGORITHMS = {
     "PG": _pg,
     "PPO": _ppo,
@@ -74,6 +89,9 @@ ALGORITHMS = {
     "TD3": _td3,
     "APEX_DDPG": _apex_ddpg,
     "SAC": _sac,
+    "APPO": _appo,
+    "ES": _es,
+    "ARS": _ars,
 }
 
 

@@ -163,6 +163,36 @@ class SyntheticAtari(Env):
         return self._frame(), reward, self._t >= self.episode_len, {}
 
 
+class RepeatInitialObs(Env):
+    """Cue-recall memory task (parity: the reference's
+    `RepeatInitialObsEnv` LSTM example env): a one-hot cue appears only at
+    t=0; the agent is rewarded for emitting the cue's index at every
+    step. Feedforward policies are capped at chance (1/num_cues); any
+    working recurrent policy solves it quickly — a sharp regression test
+    for state threading + BPTT."""
+
+    def __init__(self, num_cues: int = 3, episode_len: int = 6):
+        self.num_cues = num_cues
+        self.episode_len = episode_len
+        self.observation_space = Box(
+            0.0, 1.0, shape=(num_cues,))
+        self.action_space = Discrete(num_cues)
+        self._rng = np.random.default_rng()
+
+    def reset(self):
+        self._cue = int(self._rng.integers(self.num_cues))
+        self._t = 0
+        obs = np.zeros(self.num_cues, np.float32)
+        obs[self._cue] = 1.0
+        return obs
+
+    def step(self, action):
+        self._t += 1
+        reward = 1.0 if int(action) == self._cue else 0.0
+        return (np.zeros(self.num_cues, np.float32), reward,
+                self._t >= self.episode_len, {})
+
+
 class StatelessCartPole(CartPole):
     """CartPole with velocity components hidden — requires memory (used to
     exercise recurrent policies, parity: RLlib's stateless cartpole

@@ -9,7 +9,8 @@ from __future__ import annotations
 
 from typing import Callable, Dict
 
-from .env import CartPole, Pendulum, StatelessCartPole, SyntheticAtari
+from .env import (CartPole, Pendulum, RepeatInitialObs, StatelessCartPole,
+                  SyntheticAtari)
 
 _REGISTRY: Dict[str, Callable] = {}
 
@@ -36,6 +37,10 @@ register_env("CartPole-v0", lambda cfg: CartPole(max_steps=200))
 register_env("CartPole-v1", lambda cfg: CartPole(max_steps=500))
 register_env("Pendulum-v0", lambda cfg: Pendulum())
 register_env("StatelessCartPole-v0", lambda cfg: StatelessCartPole())
+register_env("RepeatInitialObs-v0",
+             lambda cfg: RepeatInitialObs(
+                 num_cues=cfg.get("num_cues", 3),
+                 episode_len=cfg.get("episode_len", 6)))
 register_env("SyntheticAtari-v0",
              lambda cfg: SyntheticAtari(
                  episode_len=cfg.get("episode_len", 1000),

@@ -70,9 +70,15 @@ class RolloutWorker:
         use_gae = cfg.get("use_gae", True)
         use_critic = cfg.get("use_critic", True)
 
-        def postprocess(chunk: SampleBatch, bootstrap_obs):
+        def postprocess(chunk: SampleBatch, bootstrap_obs,
+                        bootstrap_state=None):
             if bootstrap_obs is None or not use_gae:
                 last_r = 0.0
+            elif getattr(self.policy, "recurrent", False):
+                # Bootstrap value is state-dependent: evaluate at the
+                # RNN state reached after the fragment's last step.
+                last_r = float(self.policy.value_function(
+                    bootstrap_obs[None], state=bootstrap_state)[0])
             else:
                 last_r = float(self.policy.value_function(
                     bootstrap_obs[None])[0])
@@ -81,7 +87,12 @@ class RolloutWorker:
                     chunk, last_r, gamma=gamma, lambda_=lambda_,
                     use_gae=use_gae and sb.VF_PREDS in chunk,
                     use_critic=use_critic)
-            return self.policy.postprocess_trajectory(chunk)
+            chunk = self.policy.postprocess_trajectory(chunk)
+            if getattr(self.policy, "recurrent", False):
+                from ..policy.rnn_sequencing import pad_chunk_to_sequences
+                chunk = pad_chunk_to_sequences(
+                    chunk, self.policy.train_seq_len)
+            return chunk
 
         self.sampler = SyncSampler(
             self.env, self.policy, rollout_fragment_length,

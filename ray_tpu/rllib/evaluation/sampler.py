@@ -94,6 +94,13 @@ class SyncSampler:
         self._builders = [self._new_builder()
                           for _ in range(self.env.num_envs)]
         self.metrics: List[RolloutMetrics] = []
+        # Recurrent policies: per-env-slot RNN state threaded through the
+        # loop; zeroed at episode boundaries (parity: the reference
+        # sampler's state-in/state-out handling, `sampler.py:226`).
+        get_init = getattr(policy, "get_initial_state", None)
+        self._rnn_state = list(get_init(self.env.num_envs)) \
+            if get_init is not None else []
+        self._postprocess_takes_state = None  # resolved lazily
 
     def _preprocess(self, obs):
         if self.preprocessor is not None:
@@ -114,12 +121,34 @@ class SyncSampler:
         self._eps_counter += 1
         return _EpisodeBuilder(self._eps_counter)
 
+    def _bootstrap_state(self, i: int):
+        """Current RNN state slice for env slot i (None if feedforward)."""
+        if not self._rnn_state:
+            return None
+        return [s[i:i + 1] for s in self._rnn_state]
+
+    def _postprocess(self, chunk, bootstrap_obs, bootstrap_state):
+        if self._postprocess_takes_state is None:
+            import inspect
+            try:
+                sig = inspect.signature(self.postprocess_fn)
+                self._postprocess_takes_state = len(sig.parameters) >= 3
+            except (TypeError, ValueError):
+                self._postprocess_takes_state = False
+        if self._postprocess_takes_state:
+            return self.postprocess_fn(chunk, bootstrap_obs,
+                                       bootstrap_state)
+        return self.postprocess_fn(chunk, bootstrap_obs)
+
     def sample(self) -> SampleBatch:
         chunks: List[SampleBatch] = []
         for _ in range(self.T):
             obs = self._obs
-            actions, _, extra = self.policy.compute_actions(
-                obs, explore=self.explore)
+            actions, state_out, extra = self.policy.compute_actions(
+                obs, state_batches=self._rnn_state, explore=self.explore)
+            if self._rnn_state:
+                # Writable copies: episode resets zero slots in place.
+                self._rnn_state = [np.array(s) for s in state_out]
             next_obs, rewards, dones, infos = self.env.step(actions)
             next_obs = self._filter(self._preprocess(next_obs))
             for i in range(self.env.num_envs):
@@ -155,9 +184,12 @@ class SyncSampler:
                     else:
                         chunk = b.build()
                         if self.postprocess_fn is not None:
-                            chunk = self.postprocess_fn(chunk, None)
+                            chunk = self._postprocess(chunk, None, None)
                         chunks.append(chunk)
                         self._builders[i] = self._new_builder()
+                    # Fresh episode -> zero this slot's RNN state.
+                    for s in self._rnn_state:
+                        s[i] = 0.0
                     fresh = self._preprocess_one(self.env.reset_at(i))
                     next_obs[i] = fresh if self.obs_filter is None \
                         else self.obs_filter(fresh)
@@ -168,7 +200,8 @@ class SyncSampler:
             if b.count() > 0:
                 chunk = b.build()
                 if self.postprocess_fn is not None:
-                    chunk = self.postprocess_fn(chunk, self._obs[i])
+                    chunk = self._postprocess(chunk, self._obs[i],
+                                              self._bootstrap_state(i))
                 chunks.append(chunk)
                 # Continue the same episode in a fresh builder (same eps id
                 # continuity is not required by GAE: each chunk was already

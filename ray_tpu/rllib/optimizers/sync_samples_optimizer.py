@@ -72,10 +72,18 @@ class MultiDeviceOptimizer(PolicyOptimizer):
         self.learner_stats = {}
 
     def _standardize(self, batch):
+        import numpy as np
+        mask = batch.get("seq_mask")
         for field in self.standardize_fields:
             if field in batch:
                 v = batch[field]
-                batch[field] = (v - v.mean()) / max(1e-4, v.std())
+                if mask is not None:
+                    # Exclude padded rows from the statistics.
+                    valid = v[mask > 0]
+                    mean, std = valid.mean(), valid.std()
+                else:
+                    mean, std = v.mean(), v.std()
+                batch[field] = (v - mean) / max(1e-4, std)
         return batch
 
     def step(self) -> dict:
@@ -86,16 +94,26 @@ class MultiDeviceOptimizer(PolicyOptimizer):
             # Per-policy SGD phases (parity: the reference routes
             # multi-agent through per-policy learn_on_batch).
             worker = self.workers.local_worker
-            self.learner_stats = {
-                pid: worker.policy_map[pid].sgd_learn(
-                    self._standardize(b), self.num_sgd_iter,
-                    min(self.sgd_minibatch_size, b.count))
-                for pid, b in batch.policy_batches.items()}
+            self.learner_stats = {}
+            for pid, b in batch.policy_batches.items():
+                policy = worker.policy_map[pid]
+                seq_len = getattr(policy, "train_seq_len", 1)
+                mb = min(self.sgd_minibatch_size, b.count)
+                if seq_len > 1 and mb % seq_len:
+                    mb = max(seq_len, (mb // seq_len) * seq_len)
+                self.learner_stats[pid] = policy.sgd_learn(
+                    self._standardize(b), self.num_sgd_iter, mb,
+                    seq_len=seq_len)
         else:
             self._standardize(batch)
-            self.learner_stats = \
-                self.workers.local_worker.policy.sgd_learn(
-                    batch, self.num_sgd_iter, self.sgd_minibatch_size)
+            policy = self.workers.local_worker.policy
+            seq_len = getattr(policy, "train_seq_len", 1)
+            mb = self.sgd_minibatch_size
+            if seq_len > 1 and mb % seq_len:
+                # Round the minibatch up to whole sequences.
+                mb = max(seq_len, (mb // seq_len) * seq_len)
+            self.learner_stats = policy.sgd_learn(
+                batch, self.num_sgd_iter, mb, seq_len=seq_len)
         self.num_steps_sampled += batch.count
         self.num_steps_trained += batch.count
         return self.learner_stats

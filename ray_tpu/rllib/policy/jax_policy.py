@@ -41,6 +41,7 @@ _DEVICE_COLUMNS = (
     sb.OBS, sb.NEW_OBS, sb.ACTIONS, sb.REWARDS, sb.DONES, sb.ACTION_LOGP,
     sb.ACTION_DIST_INPUTS, sb.VF_PREDS, sb.ADVANTAGES, sb.VALUE_TARGETS,
     sb.PREV_ACTIONS, sb.PREV_REWARDS, "weights", "seq_mask",
+    "state_in_c", "state_in_h",
 )
 
 
@@ -89,8 +90,30 @@ class JaxPolicy(Policy):
         self._host_rng = jax.random.PRNGKey(seed)
         self._rng_counter = 0
 
-        dummy = np.zeros((1,) + tuple(obs_shape), dtype=obs_dtype)
-        self.params = self.model.init(self._next_rng(), dummy)
+        model_cfg = dict(catalog.MODEL_DEFAULTS)
+        model_cfg.update(config.get("model") or {})
+        # Recurrent path (parity: rnn_sequencing + lstm_v1 use_lstm): the
+        # sampler threads (c, h) state through rollouts; training runs the
+        # LSTM scan over [B, train_seq_len] sequences with per-sequence
+        # initial state and done-driven resets. Detected from the MODEL
+        # (catalog returns LSTMNetwork for use_lstm), not the config flag
+        # alone — subclasses supplying non-recurrent custom models via
+        # make_model must not be forced down the recurrent path.
+        self.recurrent = hasattr(self.model, "initial_state")
+        self.cell_size = int(model_cfg.get("lstm_cell_size", 256))
+        self.train_seq_len = int(
+            config.get("_train_seq_len")
+            or model_cfg.get("max_seq_len", 20)) if self.recurrent else 1
+
+        if self.recurrent:
+            dummy = np.zeros((1, 1) + tuple(obs_shape), dtype=obs_dtype)
+            dummy_state = self.model.initial_state(1)
+            dummy_mask = np.zeros((1, 1), np.float32)
+            self.params = self.model.init(
+                self._next_rng(), dummy, dummy_state, dummy_mask)
+        else:
+            dummy = np.zeros((1,) + tuple(obs_shape), dtype=obs_dtype)
+            self.params = self.model.init(self._next_rng(), dummy)
         self.optimizer = (optimizer_fn or default_optimizer)(config)
         self.opt_state = self.optimizer.init(self.params)
 
@@ -117,27 +140,93 @@ class JaxPolicy(Policy):
         self._update_lock = threading.Lock()
 
     # ------------------------------------------------------------------
-    def apply(self, params, obs, **kwargs):
-        """Model forward: (dist_inputs, value)."""
-        return self.model.apply(params, obs, **kwargs)
+    def apply(self, params, obs, *args, **kwargs):
+        """Model forward: (dist_inputs, value) — recurrent models take
+        (obs[B,T], state, reset_mask) and also return the final carry."""
+        return self.model.apply(params, obs, *args, **kwargs)
+
+    def apply_batch(self, params, batch):
+        """Forward over a flat training batch -> flat (dist_inputs, value).
+
+        Feedforward: one apply over [N]. Recurrent: reshape to
+        [B, train_seq_len], run the LSTM scan with each sequence's stored
+        initial state and done-driven resets, flatten back to [N]."""
+        if not self.recurrent:
+            return self.apply(params, batch[sb.OBS])
+        dist_bt, val_bt, _ = self.apply_sequences(params, batch)
+        O = dist_bt.shape[-1]
+        return dist_bt.reshape(-1, O), val_bt.reshape(-1)
+
+    def apply_sequences(self, params, batch):
+        """Recurrent forward over [B, L] sequences.
+
+        Returns (dist_inputs[B,L,O], value[B,L], final_carry). Initial
+        state is each sequence's first-row recorded state; resets fire
+        WITHIN a sequence where the previous step was done (packed
+        fragments cross episodes; padded chunks never do)."""
+        L = self.train_seq_len
+        obs = batch[sb.OBS]
+        B = obs.shape[0] // L
+        obs_bt = obs.reshape((B, L) + obs.shape[1:])
+        state = (batch["state_in_c"].reshape(B, L, -1)[:, 0],
+                 batch["state_in_h"].reshape(B, L, -1)[:, 0])
+        dones = batch[sb.DONES].reshape(B, L)
+        # reset before step t iff step t-1 (same sequence) was terminal
+        reset = jnp.concatenate(
+            [jnp.zeros((B, 1), jnp.float32), dones[:, :-1]], axis=1)
+        return self.apply(params, obs_bt, state, reset)
+
+    def get_initial_state(self, batch_size: int = 1):
+        """Per-env rollout state columns ([] for feedforward policies)."""
+        if not self.recurrent:
+            return []
+        return [np.zeros((batch_size, self.cell_size), np.float32),
+                np.zeros((batch_size, self.cell_size), np.float32)]
 
     def _next_rng(self):
         self._rng_counter += 1
         return jax.random.fold_in(self._host_rng, self._rng_counter)
 
     def _build_jitted_fns(self):
-        def action_fn(params, obs, rng, explore):
-            dist_inputs, value = self.apply(params, obs)
-            dist = self.dist_class(dist_inputs)
-            actions = jax.lax.cond(
-                explore,
-                lambda: dist.sample(rng),
-                lambda: dist.deterministic_sample())
-            logp = dist.logp(actions)
-            return actions, logp, dist_inputs, value
+        if self.recurrent:
+            def action_fn(params, obs, state, rng, explore):
+                # One time step: [B] -> [B, 1].
+                obs_bt = obs[:, None]
+                reset = jnp.zeros((obs.shape[0], 1), jnp.float32)
+                dist_bt, val_bt, carry = self.apply(
+                    params, obs_bt, state, reset)
+                dist_inputs, value = dist_bt[:, 0], val_bt[:, 0]
+                dist = self.dist_class(dist_inputs)
+                actions = jax.lax.cond(
+                    explore,
+                    lambda: dist.sample(rng),
+                    lambda: dist.deterministic_sample())
+                logp = dist.logp(actions)
+                return actions, logp, dist_inputs, value, carry
 
-        self._action_fn = jax.jit(action_fn)
-        self._value_fn = jax.jit(lambda params, obs: self.apply(params, obs)[1])
+            self._action_fn = jax.jit(action_fn)
+
+            def value_fn(params, obs, state):
+                obs_bt = obs[:, None]
+                reset = jnp.zeros((obs.shape[0], 1), jnp.float32)
+                _, val_bt, _ = self.apply(params, obs_bt, state, reset)
+                return val_bt[:, 0]
+
+            self._value_fn = jax.jit(value_fn)
+        else:
+            def action_fn(params, obs, rng, explore):
+                dist_inputs, value = self.apply(params, obs)
+                dist = self.dist_class(dist_inputs)
+                actions = jax.lax.cond(
+                    explore,
+                    lambda: dist.sample(rng),
+                    lambda: dist.deterministic_sample())
+                logp = dist.logp(actions)
+                return actions, logp, dist_inputs, value
+
+            self._action_fn = jax.jit(action_fn)
+            self._value_fn = jax.jit(
+                lambda params, obs: self.apply(params, obs)[1])
 
         def loss_and_grad(params, batch, rng, loss_state):
             (loss, stats), grads = jax.value_and_grad(
@@ -184,20 +273,47 @@ class JaxPolicy(Policy):
     def compute_actions(self, obs_batch, state_batches=None, explore=True,
                         prev_action_batch=None, prev_reward_batch=None):
         obs = jnp.asarray(obs_batch)
-        with self._update_lock:
-            actions, logp, dist_inputs, value = self._action_fn(
-                self.params, obs, self._next_rng(), explore)
-        extra = {
-            sb.ACTION_LOGP: np.asarray(logp),
-            sb.ACTION_DIST_INPUTS: np.asarray(dist_inputs),
-            sb.VF_PREDS: np.asarray(value),
-        }
+        if self.recurrent:
+            if not state_batches:
+                state_batches = self.get_initial_state(len(obs_batch))
+            state = (jnp.asarray(state_batches[0]),
+                     jnp.asarray(state_batches[1]))
+            with self._update_lock:
+                actions, logp, dist_inputs, value, carry = self._action_fn(
+                    self.params, obs, state, self._next_rng(), explore)
+            extra = {
+                sb.ACTION_LOGP: np.asarray(logp),
+                sb.ACTION_DIST_INPUTS: np.asarray(dist_inputs),
+                sb.VF_PREDS: np.asarray(value),
+                # Pre-step state rows: the learner takes each training
+                # sequence's first row as its initial LSTM state.
+                "state_in_c": np.asarray(state_batches[0]),
+                "state_in_h": np.asarray(state_batches[1]),
+            }
+            state_out = [np.asarray(carry[0]), np.asarray(carry[1])]
+        else:
+            with self._update_lock:
+                actions, logp, dist_inputs, value = self._action_fn(
+                    self.params, obs, self._next_rng(), explore)
+            extra = {
+                sb.ACTION_LOGP: np.asarray(logp),
+                sb.ACTION_DIST_INPUTS: np.asarray(dist_inputs),
+                sb.VF_PREDS: np.asarray(value),
+            }
+            state_out = []
         if self._extra_action_out_fn is not None:
             extra.update(self._extra_action_out_fn(self, extra))
-        return np.asarray(actions), [], extra
+        return np.asarray(actions), state_out, extra
 
-    def value_function(self, obs_batch):
-        return np.asarray(self._value_fn(self.params, jnp.asarray(obs_batch)))
+    def value_function(self, obs_batch, state=None):
+        obs = jnp.asarray(obs_batch)
+        if self.recurrent:
+            if not state:
+                state = self.get_initial_state(len(obs_batch))
+            return np.asarray(self._value_fn(
+                self.params, obs,
+                (jnp.asarray(state[0]), jnp.asarray(state[1]))))
+        return np.asarray(self._value_fn(self.params, obs))
 
     # ------------------------------------------------------------------
     # learning

@@ -53,7 +53,7 @@ class Policy:
     def set_weights(self, weights) -> None:
         raise NotImplementedError
 
-    def get_initial_state(self) -> List:
+    def get_initial_state(self, batch_size: int = 1) -> List:
         return []
 
     def is_recurrent(self) -> bool:

@@ -1,0 +1,45 @@
+"""Sequence padding for recurrent training batches.
+
+Parity: `rllib/policy/rnn_sequencing.py` — the reference chops episode
+chunks into <= max_seq_len runs, records seq_lens, and feeds dynamic-
+length sequences. TPU re-design: every sequence is padded to EXACTLY
+`max_seq_len` rows with a `seq_mask` column (1 = real, 0 = pad), so all
+training shapes are static — XLA compiles one program regardless of
+episode lengths — and minibatch shuffling happens at whole-sequence
+granularity (`JaxPolicy.sgd_learn(seq_len=...)`).
+
+Because the recurrent rollout path records each row's pre-step LSTM state
+(`state_in_c`/`state_in_h` columns), a chunk split at row k automatically
+gives the second sequence the correct initial state: its first row's
+recorded state.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..sample_batch import SampleBatch
+
+
+def pad_chunk_to_sequences(chunk: SampleBatch,
+                           max_seq_len: int) -> SampleBatch:
+    """Pad one contiguous episode chunk into ceil(n/L) sequences of
+    exactly L rows each, adding a `seq_mask` column."""
+    n = chunk.count
+    L = max_seq_len
+    num_seq = max(1, (n + L - 1) // L)
+    padded_n = num_seq * L
+    pad = padded_n - n
+    out = {}
+    for k, v in chunk.items():
+        if isinstance(v, np.ndarray):
+            if pad:
+                pad_block = np.zeros((pad,) + v.shape[1:], dtype=v.dtype)
+                v = np.concatenate([v, pad_block], axis=0)
+            out[k] = v
+        else:
+            out[k] = list(v) + [None] * pad
+    mask = np.zeros(padded_n, np.float32)
+    mask[:n] = 1.0
+    out["seq_mask"] = mask
+    return SampleBatch(out)

@@ -105,10 +105,12 @@ class TestHorizonPlumbing:
             if ep.count == 5:
                 assert bool(ep[sb.DONES][-1])
 
-    def test_use_lstm_raises_clearly(self):
+    def test_use_lstm_builds_recurrent_model(self):
+        # use_lstm now resolves to the recurrent trunk (the recurrent
+        # policy path drives it; see tests/test_recurrent.py).
         from ray_tpu.models import catalog
         from ray_tpu.rllib.env.spaces import Box
-        with pytest.raises(NotImplementedError, match="use_lstm"):
-            catalog.get_model(
-                Box(low=-1, high=1, shape=(4,), dtype=np.float32), 2,
-                {"use_lstm": True})
+        model = catalog.get_model(
+            Box(low=-1, high=1, shape=(4,), dtype=np.float32), 2,
+            {"use_lstm": True})
+        assert hasattr(model, "initial_state")
