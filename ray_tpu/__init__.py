@@ -51,9 +51,13 @@ def init(num_cpus: _Optional[float] = None,
          resources: _Optional[dict] = None,
          local_mode: bool = False,
          num_initial_workers: int = 0,
-         worker_env: _Optional[dict] = None):
+         worker_env: _Optional[dict] = None,
+         address: _Optional[str] = None):
     """Start the runtime (parity: `ray.init`, `python/ray/worker.py:525`).
 
+    With `address="tcp://host:port"` the driver attaches to an existing
+    head started by `python -m ray_tpu.scripts start --head` (parity:
+    `ray.init(redis_address=...)`); shutdown then only detaches.
     In a worker process this is a no-op (the worker is already connected).
     """
     global _LOCAL_RUNTIME
@@ -70,7 +74,7 @@ def init(num_cpus: _Optional[float] = None,
     return _node.init(resources=resources, num_cpus=num_cpus,
                       num_tpus=num_tpus,
                       num_initial_workers=num_initial_workers,
-                      worker_env=worker_env)
+                      worker_env=worker_env, address=address)
 
 
 def shutdown():
@@ -172,6 +176,29 @@ def remote(*args, **kwargs):
     return make
 
 
+def profile(event_name: str, extra_data: _Optional[dict] = None):
+    """User-level profiling span recorded into the cluster timeline
+    (parity: `ray.profile`, `python/ray/profiling.py:17`):
+
+        with ray_tpu.profile("preprocess"):
+            ...
+    """
+    rt = _ws.get_runtime()
+    return rt.profiler.span("user", event_name, extra_data)
+
+
+def timeline(filename: _Optional[str] = None):
+    """Cluster-wide Chrome trace of task/actor/user spans (parity:
+    `ray.timeline` / `GlobalState.chrome_tracing_dump`, state.py:672).
+    Returns the trace event list, or writes JSON to `filename` for
+    chrome://tracing / Perfetto."""
+    from ._private import profiling as _prof
+    events = _ws.get_runtime().get_profile_events()
+    if filename is not None:
+        return _prof.dump_chrome_trace(events, filename)
+    return _prof.chrome_trace(events)
+
+
 def cluster_resources() -> dict:
     return _ws.get_runtime().cluster_info()["total_resources"]
 
@@ -189,6 +216,6 @@ __all__ = [
     "ObjectLostError", "ObjectRef", "RayActorError", "RayError",
     "RayTaskError", "TaskError", "WorkerCrashedError", "available_resources",
     "cluster_info", "cluster_resources", "exceptions", "exit_actor", "free",
-    "get", "get_actor", "init", "is_initialized", "kill", "method", "put",
-    "remote", "shutdown", "wait",
+    "get", "get_actor", "init", "is_initialized", "kill", "method",
+    "profile", "put", "remote", "shutdown", "timeline", "wait",
 ]

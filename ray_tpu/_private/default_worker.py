@@ -30,6 +30,9 @@ def main():
     rt = Runtime(args.session_dir, args.session_name, args.head_sock,
                  role="worker")
     worker_state.set_runtime(rt, mode=worker_state.WORKER_MODE)
+    # Only execute tasks once the process-global runtime handle is set
+    # (user task code may call the ray_tpu API).
+    rt.start_task_loop()
     rt.run_worker_loop()
 
 

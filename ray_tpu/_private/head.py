@@ -139,6 +139,7 @@ class HeadServer:
         self._shutdown = False
         self._token_counter = 0
         self._unregistered_deaths = 0
+        self._profile_events: List[dict] = []
 
         self.server = protocol.Server(
             self.sock_path, self._handle, on_connect=self._on_connect,
@@ -417,6 +418,13 @@ class HeadServer:
             except protocol.ConnectionClosed:
                 pass
 
+    def _h_session_info(self, conn, msg):
+        """Bootstrap info for late-attaching drivers (`ray_tpu.init(
+        address=...)` — parity: connecting to a running `ray start`
+        cluster)."""
+        conn.reply(msg, session_name=self.session_name,
+                   session_dir=self.session_dir)
+
     # -- introspection ---------------------------------------------------
     def _h_cluster_info(self, conn, msg):
         with self._lock:
@@ -442,6 +450,19 @@ class HeadServer:
 
     def _h_report_error(self, conn, msg):
         self._publish("error", msg["data"])
+
+    # -- profiling (parity: GCS ProfileTable, tables.h:841) --------------
+    def _h_profile_events(self, conn, msg):
+        with self._lock:
+            self._profile_events.extend(msg["events"])
+            if len(self._profile_events) > 200_000:
+                del self._profile_events[
+                    :len(self._profile_events) - 200_000]
+
+    def _h_get_profile_events(self, conn, msg):
+        with self._lock:
+            events = list(self._profile_events)
+        conn.reply(msg, events=events)
 
     # ------------------------------------------------------------------
     # scheduling (lease grant) — runs under self._lock

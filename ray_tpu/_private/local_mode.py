@@ -16,6 +16,35 @@ from .ids import ActorID, JobID, ObjectID, TaskID
 from .object_ref import ObjectRef
 
 
+class _LocalProfiler:
+    """In-memory profiler (no head to flush to)."""
+
+    def __init__(self):
+        self.events: List[dict] = []
+
+    def span(self, category, name, extra=None):
+        import time
+
+        class _S:
+            def __enter__(s):
+                s.t0 = time.time()
+                return s
+
+            def __exit__(s, *exc):
+                import os
+                import threading
+                self.events.append({
+                    "cat": category, "name": name, "start": s.t0,
+                    "end": time.time(), "pid": os.getpid(),
+                    "tid": threading.get_ident() % 100000,
+                    "role": "local", "extra": extra})
+                return False
+        return _S()
+
+    def flush(self):
+        pass
+
+
 class LocalRuntime:
     def __init__(self):
         self.addr = "local"
@@ -24,6 +53,10 @@ class LocalRuntime:
         self._errors: Dict[ObjectID, BaseException] = {}
         self._functions: Dict[str, object] = {}
         self._actors: Dict[ActorID, object] = {}
+        self.profiler = _LocalProfiler()
+
+    def get_profile_events(self) -> list:
+        return list(self.profiler.events)
 
     # -- objects ---------------------------------------------------------
     def put(self, value) -> ObjectRef:

@@ -80,17 +80,47 @@ class Node:
             shutil.rmtree(self.session_dir, ignore_errors=True)
 
 
+class AttachedSession:
+    """A driver attached to an EXISTING head over TCP (parity: `ray.init
+    (redis_address=...)` joining a `ray start`ed cluster). Shutdown only
+    detaches — the cluster outlives the driver."""
+
+    def __init__(self, address: str):
+        from . import protocol
+        probe = protocol.connect(address, f"probe-{os.getpid()}",
+                                 lambda c, m: None,
+                                 hello_extra={"role": "probe"})
+        info = probe.request({"kind": "session_info"}, timeout=30)
+        probe.close()
+        self.session_name = info["session_name"]
+        self.session_dir = info["session_dir"]
+        self.head = None
+        self.runtime = Runtime(self.session_dir, self.session_name,
+                               address, role="driver")
+
+    def shutdown(self):
+        self.runtime.shutdown()
+
+
 def init(resources: Optional[Dict[str, float]] = None,
          num_cpus: Optional[float] = None,
          num_tpus: Optional[float] = None,
          num_initial_workers: int = 0,
          worker_env: Optional[dict] = None,
-         enable_tcp: bool = False) -> "Node":
+         enable_tcp: bool = False,
+         address: Optional[str] = None):
     global _node
     with _lock:
         if _node is not None:
             raise RuntimeError("ray_tpu.init() called twice; call "
                                "ray_tpu.shutdown() first")
+        if address is not None:
+            session = AttachedSession(address)
+            _node = session
+            worker_state.set_runtime(session.runtime,
+                                     worker_state.SCRIPT_MODE)
+            atexit.register(_atexit_shutdown)
+            return session
         res = default_resources()
         if num_cpus is not None:
             res["CPU"] = float(num_cpus)

@@ -10,6 +10,18 @@ from __future__ import annotations
 
 from .ids import ObjectID
 
+# Process-global reference tracker, installed by the Runtime. Every
+# ObjectRef constructed in this process (including ones deserialized out
+# of task args/results) counts toward the local refcount that gates
+# owner-side eviction (parity: `ReferenceCounter` local refs,
+# `src/ray/core_worker/reference_count.h`).
+_tracker = None
+
+
+def set_ref_tracker(tracker) -> None:
+    global _tracker
+    _tracker = tracker
+
 
 class ObjectRef:
     __slots__ = ("id", "owner_addr", "size_hint")
@@ -19,6 +31,15 @@ class ObjectRef:
         self.id = oid
         self.owner_addr = owner_addr
         self.size_hint = size_hint
+        if _tracker is not None:
+            _tracker.incref(oid, owner_addr)
+
+    def __del__(self):
+        if _tracker is not None:
+            try:
+                _tracker.decref(self.id, self.owner_addr)
+            except Exception:
+                pass  # interpreter shutdown
 
     def hex(self) -> str:
         return self.id.hex()

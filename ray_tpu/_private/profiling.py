@@ -1,0 +1,131 @@
+"""Span profiler: per-process event buffers flushed to the head.
+
+Parity: `src/ray/core_worker/profiling.h:14` (`Profiler`/`ProfileEvent`
+batching spans to the GCS ProfileTable) + `python/ray/profiling.py:17`
+(`ray.profile` user spans) + `python/ray/state.py:672`
+(`chrome_tracing_dump`). Spans are (category, name, start, end) tuples
+tagged with pid/role; the head aggregates them and `ray_tpu.timeline()`
+renders Chrome-trace JSON viewable in chrome://tracing / Perfetto.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, Optional
+
+FLUSH_INTERVAL = 1.0
+MAX_BUFFER = 5000
+
+
+class ProfileEvent:
+    __slots__ = ("category", "name", "start", "end", "pid", "tid", "extra")
+
+    def __init__(self, category: str, name: str, start: float, end: float,
+                 pid: int, tid: int, extra: Optional[dict] = None):
+        self.category = category
+        self.name = name
+        self.start = start
+        self.end = end
+        self.pid = pid
+        self.tid = tid
+        self.extra = extra
+
+    def view(self) -> dict:
+        d = {"cat": self.category, "name": self.name, "start": self.start,
+             "end": self.end, "pid": self.pid, "tid": self.tid}
+        if self.extra:
+            d["extra"] = self.extra
+        return d
+
+
+class Profiler:
+    """Buffers spans; a background thread flushes them to the head."""
+
+    def __init__(self, runtime, role: str):
+        self._runtime = runtime
+        self.role = role
+        self._buf: List[dict] = []
+        self._lock = threading.Lock()
+        self._stopped = False
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True, name="profiler-flush")
+        self._thread.start()
+
+    def record(self, category: str, name: str, start: float, end: float,
+               extra: Optional[dict] = None):
+        ev = ProfileEvent(category, name, start, end, os.getpid(),
+                          threading.get_ident() % 100000, extra).view()
+        ev["role"] = self.role
+        with self._lock:
+            self._buf.append(ev)
+            if len(self._buf) > MAX_BUFFER:
+                del self._buf[:len(self._buf) - MAX_BUFFER]
+
+    def span(self, category: str, name: str, extra: Optional[dict] = None):
+        return _Span(self, category, name, extra)
+
+    def _flush_loop(self):
+        while not self._stopped:
+            time.sleep(FLUSH_INTERVAL)
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            if not self._buf:
+                return
+            batch, self._buf = self._buf, []
+        try:
+            self._runtime.head.send(
+                {"kind": "profile_events", "events": batch})
+        except Exception:
+            pass
+
+    def stop(self):
+        self._stopped = True
+        self.flush()
+
+
+class _Span:
+    __slots__ = ("_profiler", "_category", "_name", "_extra", "_start")
+
+    def __init__(self, profiler, category, name, extra):
+        self._profiler = profiler
+        self._category = category
+        self._name = name
+        self._extra = extra
+
+    def __enter__(self):
+        self._start = time.time()
+        return self
+
+    def __exit__(self, *exc):
+        self._profiler.record(self._category, self._name, self._start,
+                              time.time(), self._extra)
+        return False
+
+
+def chrome_trace(events: List[dict]) -> List[dict]:
+    """Convert head-collected span dicts to Chrome-trace 'X' events
+    (parity: `GlobalState.chrome_tracing_dump`, state.py:672)."""
+    out = []
+    for e in events:
+        out.append({
+            "cat": e.get("cat", ""),
+            "name": e.get("name", ""),
+            "ph": "X",
+            "ts": e["start"] * 1e6,          # microseconds
+            "dur": (e["end"] - e["start"]) * 1e6,
+            "pid": f"{e.get('role', '?')}:{e['pid']}",
+            "tid": e["tid"],
+            "args": e.get("extra") or {},
+        })
+    return out
+
+
+def dump_chrome_trace(events: List[dict], filename: str) -> str:
+    with open(filename, "w") as f:
+        json.dump(chrome_trace(events), f)
+    return filename

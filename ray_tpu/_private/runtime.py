@@ -48,6 +48,63 @@ logger = logging.getLogger(__name__)
 OBJECT_CHUNK_SIZE = 8 * 1024 * 1024
 
 
+class _RefTracker:
+    """Local ObjectRef reference counts + borrow notifications.
+
+    Parity: `src/ray/core_worker/reference_count.h` — every live
+    ObjectRef in this process counts as a local reference; the first/last
+    reference to a FOREIGN object notifies its owner (add/remove borrow)
+    so the owner never evicts objects someone still holds a handle to.
+
+    decref runs from ObjectRef.__del__, i.e. potentially inside GC on ANY
+    thread — including mid-send on a connection. Notifications therefore
+    NEVER send inline: they enqueue (under the counts lock, preserving
+    add/remove order per object) and a dedicated thread delivers them.
+    The counts lock is reentrant so a GC-triggered __del__ inside
+    incref/decref can't self-deadlock.
+    """
+
+    def __init__(self, runtime):
+        import queue as _queue
+        self._rt = runtime
+        self._counts: Dict[ObjectID, int] = {}
+        self._lock = threading.RLock()
+        self._notify_q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._notify_thread = threading.Thread(
+            target=self._notify_loop, daemon=True, name="borrow-notify")
+        self._notify_thread.start()
+
+    def incref(self, oid: ObjectID, owner_addr: str):
+        with self._lock:
+            n = self._counts.get(oid, 0) + 1
+            self._counts[oid] = n
+            if n == 1 and owner_addr and owner_addr != self._rt.addr:
+                self._notify_q.put((owner_addr, "add_borrow", oid))
+
+    def decref(self, oid: ObjectID, owner_addr: str):
+        with self._lock:
+            n = self._counts.get(oid, 1) - 1
+            if n <= 0:
+                self._counts.pop(oid, None)
+            else:
+                self._counts[oid] = n
+            if n <= 0 and owner_addr and owner_addr != self._rt.addr:
+                self._notify_q.put((owner_addr, "remove_borrow", oid))
+
+    def count(self, oid: ObjectID) -> int:
+        with self._lock:
+            return self._counts.get(oid, 0)
+
+    def _notify_loop(self):
+        while True:
+            owner_addr, kind, oid = self._notify_q.get()
+            try:
+                self._rt._get_conn(owner_addr).send(
+                    {"kind": kind, "object_id": oid})
+            except Exception:
+                pass  # owner gone: nothing to protect anymore
+
+
 class _Cell:
     """Memory-store slot: raw serialized bytes, a decoded value, a pointer
     into the shared store, or an error."""
@@ -109,6 +166,26 @@ class Runtime:
         # Store namespaced per node: workers on one node share it; peers on
         # other nodes go through the transfer path (get_object/chunks).
         self.shm = SharedObjectStore(f"{session_name}_{self.node_id}")
+        # Lifecycle (parity: reference_count.h + plasma eviction): objects
+        # THIS process created via put()/arg-spill are tracked with sizes;
+        # when capacity is exceeded, unreferenced (no local refs, no
+        # borrows) objects evict in LRU order.
+        from collections import OrderedDict
+        self._owned: "OrderedDict[ObjectID, int]" = OrderedDict()
+        self._owned_lock = threading.Lock()
+        self._borrows: Dict[ObjectID, int] = {}
+        cap = os.environ.get("RAY_TPU_OBJECT_STORE_CAPACITY")
+        if cap is not None:
+            self._store_capacity = int(cap)
+        else:
+            try:
+                st = os.statvfs(
+                    os.environ.get("RAY_TPU_SHM_DIR", "/dev/shm"))
+                self._store_capacity = int(
+                    st.f_bavail * st.f_frsize * 0.3)
+            except OSError:
+                self._store_capacity = 2 << 30
+        self.ref_tracker = _RefTracker(self)
         # In-flight inbound chunked transfers: oid -> {total, chunks}.
         self._chunk_buf: Dict[ObjectID, dict] = {}
         self._chunk_lock = threading.Lock()
@@ -129,6 +206,9 @@ class Runtime:
         # marks tasks failed on DisconnectClient).
         self._pending_to_addr: Dict[str, Dict[TaskID, TaskSpec]] = {}
         self._pending_lock = threading.Lock()
+        # Submitted-task arg pins (released when the first result lands).
+        self._task_arg_pins: Dict[TaskID, list] = {}
+        self._actor_creation_tasks: Dict[ActorID, TaskID] = {}
 
         # Objects another process asked for before they were ready: owner
         # forwards the result when it arrives.
@@ -155,9 +235,13 @@ class Runtime:
                              "RAY_TPU_WORKER_TOKEN", "")},
             on_close=self._on_head_close)
 
-        if role == "worker":
-            threading.Thread(target=self._task_loop, daemon=True,
-                             name="task-exec").start()
+        from .profiling import Profiler
+        self.profiler = Profiler(self, role)
+        from . import object_ref as object_ref_mod
+        object_ref_mod.set_ref_tracker(self.ref_tracker)
+        # Workers call start_task_loop() AFTER worker_state is set —
+        # executing a task before that races user code that touches the
+        # ray_tpu API from inside tasks (dispatched specs just queue).
 
     # ==================================================================
     # object API
@@ -166,8 +250,45 @@ class Runtime:
         if isinstance(value, ObjectRef):
             raise TypeError("put() of an ObjectRef is not allowed")
         oid = ObjectID.generate()
-        size = self.shm.put_serialized(oid, value)
-        return ObjectRef(oid, self.addr, size)
+        meta, buffers, total = serialization.serialize(value)
+        self._make_room(total)
+        self.shm.create_and_seal(oid, meta, buffers, total)
+        with self._owned_lock:
+            self._owned[oid] = total
+        return ObjectRef(oid, self.addr, total)
+
+    def _make_room(self, incoming: int):
+        """Evict unreferenced owned objects (LRU) until `incoming` fits
+        within capacity (parity: plasma eviction + the reference-counter
+        gate: objects with live local refs or registered borrows are
+        never evicted)."""
+        from ..exceptions import ObjectStoreFullError
+        with self._owned_lock:
+            used = sum(self._owned.values())
+            if used + incoming <= self._store_capacity:
+                return
+            victims = []
+            for oid in list(self._owned):
+                if used + incoming <= self._store_capacity:
+                    break
+                if self.ref_tracker.count(oid) > 0:
+                    continue
+                if self._borrows.get(oid, 0) > 0:
+                    continue
+                victims.append(oid)
+                used -= self._owned.pop(oid)
+            if used + incoming > self._store_capacity:
+                # Roll nothing back — evicting helped anyway.
+                for oid in victims:
+                    self.memory.delete(oid)
+                    self.shm.delete(oid)
+                raise ObjectStoreFullError(
+                    f"object store over capacity "
+                    f"({used + incoming} > {self._store_capacity} bytes) "
+                    f"and every object is still referenced")
+        for oid in victims:
+            self.memory.delete(oid)
+            self.shm.delete(oid)
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -209,6 +330,9 @@ class Runtime:
         entry = self.shm.get(ref.id)
         if entry is not None:
             self.memory.put(ref.id, _Cell("value", entry.value))
+            with self._owned_lock:  # LRU touch
+                if ref.id in self._owned:
+                    self._owned.move_to_end(ref.id)
             return entry.value
         if ref.owner_addr and ref.owner_addr != self.addr:
             self._request_from_owner(ref)
@@ -278,22 +402,28 @@ class Runtime:
                                  daemon=True).start()
         # Event-driven: every push_result put() wakes the memory-store cv
         # (reference: CoreWorker::Wait blocks on store callbacks rather
-        # than polling, core_worker.cc:258).
-        by_id = {r.id: r for r in refs}
+        # than polling, core_worker.cc:258). The id list keeps duplicates
+        # so duplicate refs count toward num_returns.
         remaining = None if deadline is None \
             else max(0.0, deadline - time.monotonic())
         ready_ids = self.memory.wait_threshold(
-            list(by_id), num_returns, remaining,
+            [r.id for r in refs], num_returns, remaining,
             extra_ready=self.shm.contains)
-        ready = [by_id[i] for i in ready_ids][:num_returns]
-        ready_set = set(ready)
-        not_ready = [r for r in refs if r not in ready_set]
+        ready_id_set = set(ready_ids)
+        ready, not_ready = [], []
+        for r in refs:  # positional partition (duplicates preserved)
+            if r.id in ready_id_set and len(ready) < num_returns:
+                ready.append(r)
+            else:
+                not_ready.append(r)
         return ready, not_ready
 
     def free(self, refs: List[ObjectRef]):
         for r in refs:
             self.memory.delete(r.id)
             self.shm.delete(r.id)
+            with self._owned_lock:
+                self._owned.pop(r.id, None)
 
     # ==================================================================
     # task submission
@@ -327,7 +457,10 @@ class Runtime:
             meta, buffers, total = serialization.serialize(v)
             if total > INLINE_OBJECT_MAX:
                 oid = ObjectID.generate()
+                self._make_room(total)
                 self.shm.create_and_seal(oid, meta, buffers, total)
+                with self._owned_lock:
+                    self._owned[oid] = total
                 return ArgSpec(ref=ObjectRef(oid, self.addr, total))
             out = bytearray(total)
             serialization.write_blob(memoryview(out), meta, buffers)
@@ -344,8 +477,30 @@ class Runtime:
             resources=resources if resources is not None else {"CPU": 1.0},
             caller_addr=self.addr, caller_node=self.node_id,
             max_retries=max_retries, name=name)
+        # Pin ref args for the task's lifetime: the TaskSpec's own
+        # ObjectRefs die as soon as it is pickled, and an unpinned
+        # spilled arg could evict before the worker increfs it
+        # (reference: the TaskManager holds submitted-task references,
+        # reference_count.h "submitted task refs").
+        self._pin_task_args(spec)
         self.head.send({"kind": "submit_task", "spec": spec})
         return [ObjectRef(oid, self.addr) for oid in spec.return_ids()]
+
+    def _pin_task_args(self, spec: TaskSpec):
+        pinned = []
+        for arg in list(spec.args) + list(spec.kwargs.values()):
+            if arg.ref is not None:
+                self.ref_tracker.incref(arg.ref.id, arg.ref.owner_addr)
+                pinned.append((arg.ref.id, arg.ref.owner_addr))
+        if pinned:
+            with self._pending_lock:
+                self._task_arg_pins[spec.task_id] = pinned
+
+    def _unpin_task_args(self, task_id: TaskID):
+        with self._pending_lock:
+            pinned = self._task_arg_pins.pop(task_id, ())
+        for oid, owner in pinned:
+            self.ref_tracker.decref(oid, owner)
 
     def create_actor(self, class_key: str, args, kwargs, resources=None,
                      max_restarts=0, max_concurrency=1, is_asyncio=False,
@@ -362,6 +517,10 @@ class Runtime:
             max_restarts=max_restarts, max_concurrency=max_concurrency,
             is_asyncio=is_asyncio, name=name,
             env_vars={str(k): str(v) for k, v in (env_vars or {}).items()})
+        # Pin ctor args until the actor constructs (unpinned on the first
+        # ALIVE/DEAD publish for it).
+        self._pin_task_args(spec)
+        self._actor_creation_tasks[actor_id] = spec.task_id
         self.head.request({"kind": "create_actor", "spec": spec}, timeout=60)
         return actor_id
 
@@ -432,6 +591,11 @@ class Runtime:
     def cluster_info(self) -> dict:
         return self.head.request({"kind": "cluster_info"}, timeout=30)["info"]
 
+    def get_profile_events(self) -> list:
+        self.profiler.flush()
+        return self.head.request({"kind": "get_profile_events"},
+                                 timeout=30)["events"]
+
     # ==================================================================
     # connections
     # ==================================================================
@@ -492,6 +656,17 @@ class Runtime:
             self._on_push_task(msg["spec"])
         elif kind == "object_chunk":
             self._on_object_chunk(msg)
+        elif kind == "add_borrow":
+            with self._owned_lock:
+                self._borrows[msg["object_id"]] = \
+                    self._borrows.get(msg["object_id"], 0) + 1
+        elif kind == "remove_borrow":
+            with self._owned_lock:
+                n = self._borrows.get(msg["object_id"], 1) - 1
+                if n <= 0:
+                    self._borrows.pop(msg["object_id"], None)
+                else:
+                    self._borrows[msg["object_id"]] = n
         elif kind == "publish":
             self._on_publish(msg)
         elif kind == "shutdown":
@@ -509,10 +684,11 @@ class Runtime:
         else:
             cell = _Cell("raw", msg["data"])
         self.memory.put(oid, cell)
-        # Clear pending-actor-task tracking.
+        # Clear pending-actor-task tracking + release arg pins.
         with self._pending_lock:
             for pending in self._pending_to_addr.values():
                 pending.pop(oid.task_id(), None)
+        self._unpin_task_args(oid.task_id())
         # Forward to any borrower that asked before we had it.
         with self._waiters_lock:
             waiters = self._object_waiters.pop(oid, ())
@@ -612,6 +788,10 @@ class Runtime:
             info = msg["data"]
             aid = info["actor_id"]
             self._actor_cache[aid] = info
+            if info.get("state") in ("ALIVE", "DEAD"):
+                tid = self._actor_creation_tasks.pop(aid, None)
+                if tid is not None:
+                    self._unpin_task_args(tid)
             ev = self._actor_events.get(aid)
             if ev is not None:
                 ev.set()
@@ -728,8 +908,9 @@ class Runtime:
 
     def _execute_one(self, spec: TaskSpec, fn) -> None:
         try:
-            args, kwargs = self._resolve_args(spec)
-            result = fn(*args, **kwargs)
+            with self.profiler.span("task", spec.describe()):
+                args, kwargs = self._resolve_args(spec)
+                result = fn(*args, **kwargs)
             self._deliver_result(spec, result)
         except SystemExit as e:
             if spec.kind == ACTOR_TASK:
@@ -874,12 +1055,19 @@ class Runtime:
                                  node=spec.caller_node)
 
     # ==================================================================
+    def start_task_loop(self):
+        threading.Thread(target=self._task_loop, daemon=True,
+                         name="task-exec").start()
+
     def run_worker_loop(self):
         """Block until shutdown (worker main)."""
         self._shutdown_event.wait()
 
     def shutdown(self):
         self._shutdown_event.set()
+        from . import object_ref as object_ref_mod
+        if object_ref_mod._tracker is self.ref_tracker:
+            object_ref_mod.set_ref_tracker(None)
         try:
             self.head.close()
         except Exception:

@@ -73,6 +73,11 @@ class ObjectLostError(RayError):
     """The object's value was lost (owner died or store evicted it)."""
 
 
+class ObjectStoreFullError(RayError):
+    """The shared object store is at capacity and nothing is evictable
+    (parity: plasma's ObjectStoreFullError)."""
+
+
 class GetTimeoutError(RayError, TimeoutError):
     """`ray_tpu.get(..., timeout=)` expired."""
 

@@ -123,9 +123,18 @@ def appo_loss(policy, params, batch, rng, loss_state):
 
 def _appo_gae_loss(policy, params, batch, rng, loss_state):
     """vtrace: False — PPO clip on worker-side GAE advantages (reference
-    appo.py routes this through the plain PPO surrogate)."""
+    appo.py routes this through the plain PPO surrogate). Recurrent
+    batches arrive padded; seq_mask excludes the pad rows from every
+    mean."""
     cfg = policy.config
     dist_inputs, value = policy.apply_batch(params, batch)
+    mask = batch.get("seq_mask")
+
+    def mmean(x):
+        if mask is None:
+            return jnp.mean(x)
+        return jnp.sum(x * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
     dist = policy.dist_class(dist_inputs)
     logp = dist.logp(batch[sb.ACTIONS])
     ratio = jnp.exp(logp - batch[sb.ACTION_LOGP])
@@ -134,17 +143,17 @@ def _appo_gae_loss(policy, params, batch, rng, loss_state):
     surrogate = jnp.minimum(
         ratio * adv,
         jnp.clip(ratio, 1.0 - clip_param, 1.0 + clip_param) * adv)
-    vf_loss = 0.5 * jnp.mean((value - batch[sb.VALUE_TARGETS]) ** 2)
-    entropy = jnp.mean(dist.entropy())
-    total = (-jnp.mean(surrogate)
+    vf_loss = 0.5 * mmean((value - batch[sb.VALUE_TARGETS]) ** 2)
+    entropy = mmean(dist.entropy())
+    total = (-mmean(surrogate)
              + cfg["vf_loss_coeff"] * vf_loss
              - cfg["entropy_coeff"] * entropy)
     stats = {
         "total_loss": total,
-        "policy_loss": -jnp.mean(surrogate),
+        "policy_loss": -mmean(surrogate),
         "vf_loss": vf_loss,
         "entropy": entropy,
-        "mean_ratio": jnp.mean(ratio),
+        "mean_ratio": mmean(ratio),
     }
     return total, stats
 

@@ -48,6 +48,11 @@ COMMON_CONFIG = {
     # === Environment ===
     "env": None,
     "env_config": {},
+    # === Offline I/O (parity: rllib/offline/io_context.py) ===
+    # "sampler" = fresh env experience; a path = JSON-lines replay dir.
+    "input": "sampler",
+    # None = discard; a path = record experiences as JSON-lines files.
+    "output": None,
     # === Resources ===
     "num_cpus_per_worker": 1,
     # TPU devices the learner's mesh spans (0 = single default device).

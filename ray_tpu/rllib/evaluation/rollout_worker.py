@@ -40,6 +40,8 @@ class RolloutWorker:
         self.worker_index = worker_index
         env_config = dict(env_config or {})
         env_config["worker_index"] = worker_index
+        # Offline I/O (parity: `rollout_worker.py` IOContext wiring).
+        self._init_offline_io(policy_config)
         multiagent = (policy_config.get("multiagent") or {}).get("policies")
         if multiagent:
             self._init_multiagent(
@@ -172,9 +174,26 @@ class RolloutWorker:
             postprocess_fn=postprocess, explore=explore,
             horizon=horizon, env_config=env_config, seed=seed)
 
+    def _init_offline_io(self, policy_config: dict):
+        self._input_reader = None
+        self._output_writer = None
+        inp = policy_config.get("input", "sampler")
+        if inp != "sampler":
+            from ..offline import JsonReader
+            self._input_reader = JsonReader(inp)
+        out = policy_config.get("output")
+        if out:
+            from ..offline import JsonWriter
+            self._output_writer = JsonWriter(out)
+
     # -- sampling --------------------------------------------------------
     def sample(self) -> SampleBatch:
-        return self.sampler.sample()
+        if self._input_reader is not None:
+            return self._input_reader.next()
+        batch = self.sampler.sample()
+        if self._output_writer is not None:
+            self._output_writer.write(batch)
+        return batch
 
     def sample_with_count(self):
         batch = self.sample()

@@ -1,0 +1,10 @@
+from .io import (InputReader, JsonReader, JsonWriter, MixedInput,
+                 OutputWriter, SamplerInput, ShuffledInput)
+from .off_policy_estimator import ImportanceSamplingEstimator, \
+    WeightedImportanceSamplingEstimator
+
+__all__ = [
+    "ImportanceSamplingEstimator", "InputReader", "JsonReader",
+    "JsonWriter", "MixedInput", "OutputWriter", "SamplerInput",
+    "ShuffledInput", "WeightedImportanceSamplingEstimator",
+]

@@ -1,0 +1,175 @@
+"""Offline experience I/O.
+
+Parity: `rllib/offline/json_reader.py` / `json_writer.py` /
+`io_context.py` — SampleBatches serialized as JSON-lines files so
+experiences can be recorded during training (`output` config) and
+replayed for offline learning (`input` config). Columns are
+base64-encoded .npy blobs (the reference packs with its `pack` util);
+arbitrary-object columns fall back to a pickled payload.
+"""
+
+from __future__ import annotations
+
+import base64
+import glob
+import io as _io
+import json
+import os
+import pickle
+import random
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..sample_batch import SampleBatch
+
+
+def _encode_array(v: np.ndarray) -> dict:
+    buf = _io.BytesIO()
+    np.save(buf, v, allow_pickle=False)
+    return {"__npy__": base64.b64encode(buf.getvalue()).decode()}
+
+
+def _encode_col(v):
+    if isinstance(v, np.ndarray) and v.dtype != object:
+        return _encode_array(v)
+    return {"__pkl__": base64.b64encode(pickle.dumps(list(v))).decode()}
+
+
+def _decode_col(d):
+    if "__npy__" in d:
+        return np.load(_io.BytesIO(base64.b64decode(d["__npy__"])),
+                       allow_pickle=False)
+    return pickle.loads(base64.b64decode(d["__pkl__"]))
+
+
+class InputReader:
+    def next(self) -> SampleBatch:
+        raise NotImplementedError
+
+
+class OutputWriter:
+    def write(self, batch: SampleBatch) -> None:
+        raise NotImplementedError
+
+
+class SamplerInput(InputReader):
+    """Reads fresh experience from a rollout worker (the default
+    'sampler' input; parity: `offline/io_context.py` default_sampler_input)."""
+
+    def __init__(self, worker):
+        self.worker = worker
+
+    def next(self) -> SampleBatch:
+        return self.worker.sample()
+
+
+class JsonWriter(OutputWriter):
+    """Parity: `rllib/offline/json_writer.py` — experiences append to
+    rolling JSON-lines files under `path`."""
+
+    def __init__(self, path: str, max_file_size: int = 64 * 1024 * 1024):
+        self.path = path
+        os.makedirs(path, exist_ok=True)
+        self.max_file_size = max_file_size
+        self._f = None
+        self._bytes = 0
+
+    def _rotate(self):
+        if self._f is not None:
+            self._f.close()
+        name = f"output-{time.strftime('%Y-%m-%d_%H-%M-%S')}" \
+               f"-{os.getpid()}-{random.randrange(10**6)}.json"
+        self._f = open(os.path.join(self.path, name), "w")
+        self._bytes = 0
+
+    def write(self, batch: SampleBatch) -> None:
+        if self._f is None or self._bytes > self.max_file_size:
+            self._rotate()
+        row = {k: _encode_col(v) for k, v in batch.items()}
+        line = json.dumps(row)
+        self._f.write(line + "\n")
+        self._f.flush()
+        self._bytes += len(line)
+
+    def close(self):
+        if self._f is not None:
+            self._f.close()
+            self._f = None
+
+
+class JsonReader(InputReader):
+    """Parity: `rllib/offline/json_reader.py` — cycles through JSON-lines
+    experience files forever (shuffled file order)."""
+
+    def __init__(self, path: str):
+        if os.path.isdir(path):
+            self.files = sorted(glob.glob(os.path.join(path, "*.json")))
+        else:
+            self.files = sorted(glob.glob(path))
+        if not self.files:
+            raise ValueError(f"no experience files under {path!r}")
+        self._lines: List[str] = []
+        self._cursor = 0
+
+    def _refill(self):
+        self._lines = []
+        for fname in self.files:
+            with open(fname) as f:
+                self._lines.extend(
+                    ln for ln in f.read().splitlines() if ln.strip())
+        random.shuffle(self._lines)
+        self._cursor = 0
+        if not self._lines:
+            raise ValueError("experience files are empty")
+
+    def next(self) -> SampleBatch:
+        if self._cursor >= len(self._lines):
+            self._refill()
+        row = json.loads(self._lines[self._cursor])
+        self._cursor += 1
+        return SampleBatch({k: _decode_col(v) for k, v in row.items()})
+
+
+class ShuffledInput(InputReader):
+    """Parity: `rllib/offline/shuffled_input.py` — n-batch shuffle buffer."""
+
+    def __init__(self, child: InputReader, n: int = 16):
+        self.child = child
+        self.n = n
+        self._buf: List[SampleBatch] = []
+
+    def next(self) -> SampleBatch:
+        if not self._buf:
+            self._buf = [self.child.next() for _ in range(self.n)]
+            random.shuffle(self._buf)
+        return self._buf.pop()
+
+
+class MixedInput(InputReader):
+    """Parity: `rllib/offline/mixed_input.py` — sample sources by
+    probability: {reader_or_'sampler': prob}."""
+
+    def __init__(self, dist: dict, worker=None):
+        self.choices = []
+        self.probs = []
+        for source, prob in dist.items():
+            if source == "sampler":
+                self.choices.append(SamplerInput(worker))
+            elif isinstance(source, str):
+                self.choices.append(JsonReader(source))
+            else:
+                self.choices.append(source)
+            self.probs.append(float(prob))
+        total = sum(self.probs)
+        self.probs = [p / total for p in self.probs]
+
+    def next(self) -> SampleBatch:
+        r = random.random()
+        acc = 0.0
+        for reader, p in zip(self.choices, self.probs):
+            acc += p
+            if r <= acc:
+                return reader.next()
+        return self.choices[-1].next()

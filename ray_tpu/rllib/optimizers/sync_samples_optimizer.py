@@ -16,7 +16,7 @@ from __future__ import annotations
 
 import ray_tpu
 
-from ..sample_batch import MultiAgentBatch, SampleBatch
+from ..sample_batch import MultiAgentBatch, SampleBatch, real_count
 from .policy_optimizer import PolicyOptimizer
 
 
@@ -53,8 +53,9 @@ class SyncSamplesOptimizer(PolicyOptimizer):
         batch = collect_train_batch(self.workers, self.train_batch_size)
         self.workers.sync_filters()
         self.learner_stats = self.workers.local_worker.learn_on_batch(batch)
-        self.num_steps_sampled += batch.count
-        self.num_steps_trained += batch.count
+        n = real_count(batch)
+        self.num_steps_sampled += n
+        self.num_steps_trained += n
         return self.learner_stats
 
 
@@ -114,6 +115,7 @@ class MultiDeviceOptimizer(PolicyOptimizer):
                 mb = max(seq_len, (mb // seq_len) * seq_len)
             self.learner_stats = policy.sgd_learn(
                 batch, self.num_sgd_iter, mb, seq_len=seq_len)
-        self.num_steps_sampled += batch.count
-        self.num_steps_trained += batch.count
+        n = real_count(batch)
+        self.num_steps_sampled += n
+        self.num_steps_trained += n
         return self.learner_stats

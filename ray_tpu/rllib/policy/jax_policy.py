@@ -372,7 +372,8 @@ class JaxPolicy(Policy):
             self.params, self.opt_state, stats = self._sgd_fns[key](
                 self.params, self.opt_state, dev_batch, self._next_rng(),
                 self.loss_state)
-        self.global_timestep += n
+        from ..sample_batch import real_count
+        self.global_timestep += real_count(batch)
         return {k: float(v) for k, v in stats.items()}
 
     def _make_sgd_fn(self, num_sgd_iter: int, num_mb: int, mb_size: int,

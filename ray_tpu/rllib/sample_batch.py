@@ -114,6 +114,19 @@ class SampleBatch(dict):
         return f"SampleBatch({self.count}: {list(self.keys())})"
 
 
+SEQ_MASK = "seq_mask"
+
+
+def real_count(batch) -> int:
+    """Env steps excluding padding rows (recurrent batches carry a
+    seq_mask; feedforward batches count every row)."""
+    if isinstance(batch, MultiAgentBatch):
+        return batch.count
+    if SEQ_MASK in batch:
+        return int(np.asarray(batch[SEQ_MASK]).sum())
+    return batch.count
+
+
 class MultiAgentBatch:
     """Batches keyed by policy id (parity: `sample_batch.py:230`)."""
 

@@ -1,0 +1,3 @@
+from .scripts import main
+
+__all__ = ["main"]

@@ -1,0 +1,232 @@
+"""The `ray` CLI equivalent.
+
+Parity: `python/ray/scripts/scripts.py` —
+
+    python -m ray_tpu.scripts start --head [--num-cpus N] [--num-tpus N]
+    python -m ray_tpu.scripts start --address tcp://h:p [--num-cpus N]
+    python -m ray_tpu.scripts stop
+    python -m ray_tpu.scripts stat --address tcp://h:p
+    python -m ray_tpu.scripts memory --address tcp://h:p
+    python -m ray_tpu.scripts timeline --address tcp://h:p [--out f.json]
+
+`start --head` boots a standalone head (scheduler + GCS + node0 worker
+pool) serving TCP and blocks; drivers attach with
+`ray_tpu.init(address=...)` (reference: `ray start --head` +
+`ray.init(redis_address=...)`, scripts.py:234). `start --address` joins
+as an additional node (a NodeAgent; reference: `ray start
+--redis-address`). `stop` kills every process this CLI started on this
+machine (reference: `ray stop`, scripts.py:426).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import signal
+import sys
+import tempfile
+import time
+
+PID_DIR = os.path.join(tempfile.gettempdir(), "ray_tpu_cli")
+ADDRESS_FILE = os.path.join(PID_DIR, "head_address")
+
+
+def _record_pid(kind: str):
+    os.makedirs(PID_DIR, exist_ok=True)
+    with open(os.path.join(PID_DIR, f"{kind}-{os.getpid()}.pid"),
+              "w") as f:
+        f.write(str(os.getpid()))
+
+
+def _connect(address: str):
+    from ray_tpu._private import protocol
+    return protocol.connect(address, f"cli-{os.getpid()}",
+                            lambda c, m: None,
+                            hello_extra={"role": "probe"})
+
+
+def cmd_start(args):
+    if args.head:
+        from ray_tpu._private import node as node_mod
+        resources = {}
+        if args.num_cpus is not None:
+            resources["CPU"] = float(args.num_cpus)
+        if args.num_tpus is not None:
+            resources["TPU"] = float(args.num_tpus)
+        node = node_mod.Node(
+            resources or node_mod.default_resources(),
+            num_initial_workers=0, enable_tcp=True)
+        _record_pid("head")
+        os.makedirs(PID_DIR, exist_ok=True)
+        with open(ADDRESS_FILE, "w") as f:
+            f.write(node.head.tcp_addr)
+        print(f"head started at {node.head.tcp_addr}")
+        print(f"attach drivers with: "
+              f"ray_tpu.init(address={node.head.tcp_addr!r})")
+        _block_until_signal()
+        node.shutdown()
+    else:
+        if not args.address:
+            sys.exit("start needs --head or --address tcp://host:port")
+        from ray_tpu._private.node_agent import NodeAgent
+        resources = {"CPU": float(args.num_cpus
+                                  if args.num_cpus is not None
+                                  else (os.cpu_count() or 1))}
+        if args.num_tpus is not None:
+            resources["TPU"] = float(args.num_tpus)
+        node_id = args.node_id or f"node-{os.getpid()}"
+        session_dir = os.path.join(
+            tempfile.gettempdir(), "ray-tpu-sessions",
+            f"agent-{node_id}")
+        os.makedirs(session_dir, exist_ok=True)
+        agent = NodeAgent(args.address, node_id, resources, session_dir,
+                          session_name=_session_name(args.address))
+        _record_pid("agent")
+        print(f"node {node_id} joined {args.address} with {resources}")
+        _block_until_signal()
+        agent.shutdown()
+
+
+def _session_name(address: str) -> str:
+    conn = _connect(address)
+    try:
+        return conn.request({"kind": "session_info"},
+                            timeout=30)["session_name"]
+    finally:
+        conn.close()
+
+
+def _block_until_signal():
+    stop = {"flag": False}
+
+    def handler(sig, frame):
+        stop["flag"] = True
+
+    signal.signal(signal.SIGTERM, handler)
+    signal.signal(signal.SIGINT, handler)
+    while not stop["flag"]:
+        time.sleep(0.2)
+
+
+def cmd_stop(args):
+    killed = 0
+    for path in glob.glob(os.path.join(PID_DIR, "*.pid")):
+        try:
+            with open(path) as f:
+                pid = int(f.read().strip())
+            if pid != os.getpid():
+                os.kill(pid, signal.SIGTERM)
+                killed += 1
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+    print(f"sent SIGTERM to {killed} process(es)")
+
+
+def _resolve_address(args) -> str:
+    if args.address:
+        return args.address
+    try:
+        with open(ADDRESS_FILE) as f:
+            return f.read().strip()
+    except OSError:
+        sys.exit("no --address given and no head address file found")
+
+
+def cmd_stat(args):
+    address = _resolve_address(args)
+    conn = _connect(address)
+    try:
+        info = conn.request({"kind": "cluster_info"}, timeout=30)["info"]
+    finally:
+        conn.close()
+    print(f"session: {info['session_name']}")
+    print(f"total resources:     {info['total_resources']}")
+    print(f"available resources: {info['available_resources']}")
+    print(f"workers: {info['num_workers']}  pending tasks: "
+          f"{info['num_pending_tasks']}")
+    for nid, n in info.get("nodes", {}).items():
+        print(f"  node {nid}: alive={n['alive']} "
+              f"avail={n['available_resources']}")
+    actors = info.get("actors", {})
+    alive = sum(1 for a in actors.values() if a["state"] == "ALIVE")
+    print(f"actors: {len(actors)} total, {alive} alive")
+
+
+def cmd_memory(args):
+    """Object-store usage per node (parity: `ray memory`)."""
+    address = _resolve_address(args)
+    conn = _connect(address)
+    try:
+        info = conn.request({"kind": "cluster_info"}, timeout=30)["info"]
+    finally:
+        conn.close()
+    session = info["session_name"]
+    shm_dir = os.environ.get("RAY_TPU_SHM_DIR", "/dev/shm")
+    by_node = {}
+    for path in glob.glob(os.path.join(
+            shm_dir, f"raytpu_{session}_*")):
+        name = os.path.basename(path)[len(f"raytpu_{session}_"):]
+        node = name.rsplit("_", 1)[0] if "_" in name else "node0"
+        try:
+            by_node.setdefault(node, [0, 0])
+            by_node[node][0] += 1
+            by_node[node][1] += os.stat(path).st_size
+        except OSError:
+            pass
+    if not by_node:
+        print("no objects in the local shared store")
+    for node, (count, size) in sorted(by_node.items()):
+        print(f"node {node}: {count} objects, {size / 1e6:.1f} MB")
+
+
+def cmd_timeline(args):
+    address = _resolve_address(args)
+    conn = _connect(address)
+    try:
+        events = conn.request({"kind": "get_profile_events"},
+                              timeout=30)["events"]
+    finally:
+        conn.close()
+    from ray_tpu._private.profiling import dump_chrome_trace
+    out = args.out or f"ray-tpu-timeline-{int(time.time())}.json"
+    dump_chrome_trace(events, out)
+    print(f"wrote {len(events)} span(s) to {out} "
+          f"(open in chrome://tracing or Perfetto)")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu.scripts")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("start", help="start a head or join as a node")
+    p.add_argument("--head", action="store_true")
+    p.add_argument("--address", default=None)
+    p.add_argument("--num-cpus", type=float, default=None)
+    p.add_argument("--num-tpus", type=float, default=None)
+    p.add_argument("--node-id", default=None)
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("stop", help="stop CLI-started processes")
+    p.set_defaults(fn=cmd_stop)
+
+    for name, fn in (("stat", cmd_stat), ("memory", cmd_memory),
+                     ("timeline", cmd_timeline)):
+        p = sub.add_parser(name)
+        p.add_argument("--address", default=None)
+        if name == "timeline":
+            p.add_argument("--out", default=None)
+        p.set_defaults(fn=fn)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

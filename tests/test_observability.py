@@ -1,0 +1,98 @@
+"""Tracing/profiling + CLI introspection.
+
+Parity: `src/ray/core_worker/profiling.h:14` (span batching),
+`python/ray/profiling.py:17` (`ray.profile`), `state.py:672`
+(chrome trace dump), `scripts.py:234/426/832/852` (`ray
+start/stop/timeline/stat`).
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+import ray_tpu
+
+
+class TestTimeline:
+    def test_task_and_user_spans_in_trace(self, ray_start, tmp_path):
+        @ray_tpu.remote
+        def work(x):
+            with ray_tpu.profile("inner-span", {"x": x}):
+                return x
+
+        assert ray_tpu.get([work.remote(i) for i in range(3)]) == [0, 1, 2]
+        with ray_tpu.profile("driver-span"):
+            pass
+        time.sleep(1.3)  # profiler flush interval
+        path = str(tmp_path / "trace.json")
+        ray_tpu.timeline(path)
+        events = json.load(open(path))
+        names = {e["name"] for e in events}
+        assert "work" in names        # task execution span
+        assert "inner-span" in names  # worker-side user span
+        assert "driver-span" in names
+        ev = next(e for e in events if e["name"] == "work")
+        assert ev["ph"] == "X" and ev["dur"] >= 0
+
+    def test_timeline_returns_events(self, ray_start):
+        @ray_tpu.remote
+        def f():
+            return 1
+
+        ray_tpu.get(f.remote())
+        time.sleep(1.3)
+        events = ray_tpu.timeline()
+        assert isinstance(events, list)
+
+
+class TestCLI:
+    def test_head_attach_stat_stop(self, tmp_path):
+        """`start --head` + driver attach + `stat` + `stop` (parity:
+        ray start/ray.init(redis_address)/ray stat/ray stop)."""
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p])
+        # NOTE: keep the default short tmp root — AF_UNIX socket paths
+        # cap at ~108 chars, and pytest tmp_path nests deeply.
+        import tempfile
+        addr_file = os.path.join(tempfile.gettempdir(), "ray_tpu_cli",
+                                 "head_address")
+        if os.path.exists(addr_file):
+            os.unlink(addr_file)
+        head = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.scripts", "start", "--head",
+             "--num-cpus", "2"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            cwd=str(tmp_path))
+        try:
+            deadline = time.time() + 30
+            while not os.path.exists(addr_file):
+                assert time.time() < deadline, "head never wrote address"
+                assert head.poll() is None, head.stdout.read().decode()
+                time.sleep(0.2)
+            address = open(addr_file).read().strip()
+
+            out = subprocess.run(
+                [sys.executable, "-m", "ray_tpu.scripts", "stat",
+                 "--address", address],
+                env=env, capture_output=True, text=True, timeout=60)
+            assert "total resources" in out.stdout, out.stderr
+
+            driver = subprocess.run(
+                [sys.executable, "-c", (
+                    "import ray_tpu\n"
+                    f"ray_tpu.init(address={address!r})\n"
+                    "@ray_tpu.remote\n"
+                    "def f(x): return x * 2\n"
+                    "print('R=', ray_tpu.get(f.remote(21)))\n"
+                    "ray_tpu.shutdown()\n")],
+                env=env, capture_output=True, text=True, timeout=90)
+            assert "R= 42" in driver.stdout, (driver.stdout,
+                                              driver.stderr)
+        finally:
+            head.terminate()
+            head.wait(timeout=15)
