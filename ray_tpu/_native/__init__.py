@@ -1,0 +1,86 @@
+"""Native (C++) runtime components, compiled on demand.
+
+The framework's device compute path is JAX/XLA; the host runtime keeps
+its hot loops native where the reference's are (SURVEY.md §2.1). Each
+component ships as C++ source compiled once per machine with the system
+toolchain into a cached shared object and bound via ctypes — no build
+step at install time, graceful Python fallback when no compiler exists.
+
+Set RAY_TPU_NATIVE=0 to force the pure-Python fallbacks.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import logging
+import os
+import subprocess
+import tempfile
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_cache = {}
+
+
+def native_enabled() -> bool:
+    return os.environ.get("RAY_TPU_NATIVE", "1") != "0"
+
+
+def _build(src_path: str) -> Optional[str]:
+    """Compile `src_path` to a cached .so; returns the path or None."""
+    with open(src_path, "rb") as f:
+        digest = hashlib.sha256(f.read()).hexdigest()[:16]
+    base = os.path.basename(src_path).rsplit(".", 1)[0]
+    out = os.path.join(tempfile.gettempdir(),
+                       f"ray_tpu_native_{base}_{digest}.so")
+    if os.path.exists(out):
+        return out
+    tmp = out + f".build{os.getpid()}"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", src_path, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.rename(tmp, out)
+        return out
+    except (OSError, subprocess.SubprocessError) as e:
+        logger.warning("native build failed (%s); using Python fallback",
+                       e)
+        return None
+
+
+def load(name: str) -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library `name`."""
+    if not native_enabled():
+        return None
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_HERE, f"{name}.cpp")
+        lib = None
+        if os.path.exists(src):
+            so = _build(src)
+            if so is not None:
+                try:
+                    lib = ctypes.CDLL(so)
+                except OSError:
+                    lib = None
+        _cache[name] = lib
+        return lib
+
+
+def segment_tree_lib() -> Optional[ctypes.CDLL]:
+    lib = load("segment_tree")
+    if lib is not None and not getattr(lib, "_st_configured", False):
+        i64 = ctypes.c_int64
+        pd = ctypes.POINTER(ctypes.c_double)
+        pi = ctypes.POINTER(i64)
+        lib.st_set_items.argtypes = [pd, i64, pi, pd, i64, ctypes.c_int]
+        lib.st_set_items.restype = None
+        lib.st_find_prefixsum.argtypes = [pd, i64, i64, pd, pi, i64]
+        lib.st_find_prefixsum.restype = None
+        lib._st_configured = True
+    return lib

@@ -181,10 +181,16 @@ class Runtime:
             try:
                 st = os.statvfs(
                     os.environ.get("RAY_TPU_SHM_DIR", "/dev/shm"))
+                # f_blocks (total, not free) so every process on the node
+                # derives the SAME capacity — the store is node-shared.
                 self._store_capacity = int(
-                    st.f_bavail * st.f_frsize * 0.3)
+                    st.f_blocks * st.f_frsize * 0.3)
             except OSError:
                 self._store_capacity = 2 << 30
+        # Cached node-wide usage (a filesystem glob): refreshed when the
+        # cheap per-process accounting can't rule out an overrun.
+        self._store_used_cache = 0
+        self._store_used_dirty = True
         self.ref_tracker = _RefTracker(self)
         # In-flight inbound chunked transfers: oid -> {total, chunks}.
         self._chunk_buf: Dict[ObjectID, dict] = {}
@@ -261,10 +267,22 @@ class Runtime:
         """Evict unreferenced owned objects (LRU) until `incoming` fits
         within capacity (parity: plasma eviction + the reference-counter
         gate: objects with live local refs or registered borrows are
-        never evicted)."""
+        never evicted). Usage is measured NODE-WIDE (the store is shared
+        across this node's processes); each process can only evict the
+        objects it owns."""
         from ..exceptions import ObjectStoreFullError
         with self._owned_lock:
-            used = sum(self._owned.values())
+            own = sum(self._owned.values())
+            # Fast path: even if every other process held the rest of
+            # the capacity when we last looked, we still fit.
+            if self._store_used_dirty or \
+                    self._store_used_cache + own + incoming \
+                    > self._store_capacity:
+                self._store_used_cache = self.shm.used_bytes() - own
+                if self._store_used_cache < 0:
+                    self._store_used_cache = 0
+                self._store_used_dirty = False
+            used = self._store_used_cache + own
             if used + incoming <= self._store_capacity:
                 return
             victims = []
@@ -277,18 +295,15 @@ class Runtime:
                     continue
                 victims.append(oid)
                 used -= self._owned.pop(oid)
-            if used + incoming > self._store_capacity:
-                # Roll nothing back — evicting helped anyway.
-                for oid in victims:
-                    self.memory.delete(oid)
-                    self.shm.delete(oid)
-                raise ObjectStoreFullError(
-                    f"object store over capacity "
-                    f"({used + incoming} > {self._store_capacity} bytes) "
-                    f"and every object is still referenced")
+            over = used + incoming > self._store_capacity
         for oid in victims:
             self.memory.delete(oid)
             self.shm.delete(oid)
+        if over:
+            raise ObjectStoreFullError(
+                f"object store over capacity "
+                f"({used + incoming} > {self._store_capacity} bytes) "
+                f"and every object this process owns is still referenced")
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)

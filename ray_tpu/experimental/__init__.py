@@ -1,0 +1,14 @@
+"""Experimental utilities (parity: `python/ray/experimental/`)."""
+
+from .actor_pool import ActorPool
+from .async_api import as_future
+from .iter import (LocalIterator, ParallelIterator, from_items,
+                   from_iterators, from_range)
+from .multiprocessing import Pool
+from .queue import Empty, Full, Queue
+
+__all__ = [
+    "ActorPool", "Empty", "Full", "LocalIterator", "ParallelIterator",
+    "Pool", "Queue", "as_future", "from_items", "from_iterators",
+    "from_range",
+]

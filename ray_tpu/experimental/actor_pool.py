@@ -1,0 +1,93 @@
+"""ActorPool: load-balance tasks over a fixed set of actors.
+
+Parity: `python/ray/experimental/actor_pool.py` — submit/map/map_unordered
+with has_next/get_next/get_next_unordered semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List
+
+import ray_tpu
+
+
+class ActorPool:
+    def __init__(self, actors: List):
+        self._idle = list(actors)
+        self._future_to_actor = {}
+        self._index_to_future = {}
+        # Futures whose completion has NOT yet freed their actor: once a
+        # future recycles its actor it leaves this set, so a later wait
+        # can't re-select it and double-free the (now busy) actor.
+        self._outstanding = set()
+        self._next_task_index = 0
+        self._next_return_index = 0
+
+    def submit(self, fn: Callable, value: Any):
+        """fn(actor, value) -> ObjectRef; queues if all actors busy."""
+        if not self._idle:
+            # Wait for any in-flight call to finish, recycling its actor.
+            self._wait_for_one()
+        actor = self._idle.pop()
+        ref = fn(actor, value)
+        self._future_to_actor[ref] = (self._next_task_index, actor)
+        self._index_to_future[self._next_task_index] = ref
+        self._outstanding.add(ref)
+        self._next_task_index += 1
+
+    def _wait_for_one(self):
+        ready, _ = ray_tpu.wait(list(self._outstanding), num_returns=1)
+        self._recycle(ready[0])
+
+    def _recycle(self, ref):
+        if ref not in self._outstanding:
+            return  # actor already freed by an earlier wait
+        self._outstanding.discard(ref)
+        _, actor = self._future_to_actor[ref]
+        if actor not in self._idle:
+            self._idle.append(actor)
+
+    def has_next(self) -> bool:
+        return bool(self._future_to_actor)
+
+    def get_next(self, timeout=None):
+        """Next result in SUBMISSION order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ref = self._index_to_future.pop(self._next_return_index)
+        self._next_return_index += 1
+        value = ray_tpu.get(ref, timeout=timeout)
+        self._recycle(ref)
+        del self._future_to_actor[ref]
+        return value
+
+    # NOTE: get_next pops from _index_to_future first, so an out-of-order
+    # get_next after get_next_unordered raises KeyError by design
+    # (mirrors the reference's constraint of not mixing the two modes
+    # for the same pending window).
+
+    def get_next_unordered(self, timeout=None):
+        """Next result in COMPLETION order."""
+        if not self.has_next():
+            raise StopIteration("no pending results")
+        ready, _ = ray_tpu.wait(list(self._future_to_actor),
+                                num_returns=1, timeout=timeout)
+        if not ready:
+            raise TimeoutError("get_next_unordered timed out")
+        ref = ready[0]
+        self._recycle(ref)
+        idx, _ = self._future_to_actor.pop(ref)
+        self._index_to_future.pop(idx, None)
+        return ray_tpu.get(ref)
+
+    def map(self, fn: Callable, values: List[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next()
+
+    def map_unordered(self, fn: Callable, values: List[Any]):
+        for v in values:
+            self.submit(fn, v)
+        while self.has_next():
+            yield self.get_next_unordered()

@@ -1,0 +1,32 @@
+"""asyncio bridge: await ObjectRefs.
+
+Parity: `python/ray/experimental/async_api.py:108` (`as_future`) — wrap
+an ObjectRef in an asyncio Future resolved by a background waiter
+thread, so drivers can `await` framework results inside event loops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+
+import ray_tpu
+
+
+def as_future(ref, loop: asyncio.AbstractEventLoop = None
+              ) -> asyncio.Future:
+    loop = loop or asyncio.get_event_loop()
+    fut = loop.create_future()
+
+    def waiter():
+        try:
+            value = ray_tpu.get(ref)
+        except BaseException as e:  # noqa: BLE001 — forward to the future
+            loop.call_soon_threadsafe(
+                lambda: fut.cancelled() or fut.set_exception(e))
+            return
+        loop.call_soon_threadsafe(
+            lambda: fut.cancelled() or fut.set_result(value))
+
+    threading.Thread(target=waiter, daemon=True).start()
+    return fut

@@ -1,0 +1,187 @@
+"""ParallelIterator / LocalIterator.
+
+Parity: `python/ray/experimental/iter.py:101,415` — lazily-evaluated
+iterators over sharded data, with each shard hosted by an actor
+(`from_items`/`from_iterators`/`from_actors`), transformed via
+`for_each`/`filter`/`batch`/`flatten`, and consumed either shard-wise
+(`gather_sync`/`gather_async`) or locally (`LocalIterator`).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any, Callable, Iterable, Iterator, List, Optional
+
+import ray_tpu
+
+
+class _ShardActor:
+    """Hosts one shard's item stream and applies queued transforms."""
+
+    def __init__(self, make_items):
+        self._it = iter(make_items())
+
+    def par_iter_next(self, batch: int = 1):
+        out = []
+        try:
+            for _ in range(batch):
+                out.append(next(self._it))
+        except StopIteration:
+            if not out:
+                raise StopIteration_()
+        return out
+
+    def apply_transform(self, fn):
+        self._it = fn(self._it)
+        return "ok"
+
+    def ping(self):
+        return "ok"
+
+
+class StopIteration_(Exception):
+    """StopIteration can't cross the task boundary (it would terminate
+    the wrong generator); use a dedicated sentinel error."""
+
+
+def from_items(items: List[Any], num_shards: int = 2) -> "ParallelIterator":
+    shards = [items[i::num_shards] for i in range(num_shards)]
+    return from_iterators([(lambda s=s: iter(s)) for s in shards],
+                          name=f"from_items[{len(items)}]")
+
+
+def from_iterators(generators: List[Callable[[], Iterable]],
+                   name: str = "from_iterators") -> "ParallelIterator":
+    cls = ray_tpu.remote(_ShardActor)
+    actors = [cls.remote(gen) for gen in generators]
+    ray_tpu.get([a.ping.remote() for a in actors])
+    return ParallelIterator(actors, name)
+
+
+def from_range(n: int, num_shards: int = 2) -> "ParallelIterator":
+    return from_items(list(range(n)), num_shards)
+
+
+class ParallelIterator:
+    def __init__(self, actors: List, name: str):
+        self.actors = actors
+        self.name = name
+
+    def __repr__(self):
+        return f"ParallelIterator[{self.name}]"
+
+    def num_shards(self) -> int:
+        return len(self.actors)
+
+    # -- transforms (applied remotely, lazily) ---------------------------
+    def _transformed(self, fn, label: str) -> "ParallelIterator":
+        ray_tpu.get([a.apply_transform.remote(fn) for a in self.actors])
+        return ParallelIterator(self.actors, f"{self.name}.{label}")
+
+    def for_each(self, fn: Callable) -> "ParallelIterator":
+        def transform(it, _fn=fn):
+            return (_fn(x) for x in it)
+        return self._transformed(transform, "for_each()")
+
+    def filter(self, fn: Callable) -> "ParallelIterator":
+        def transform(it, _fn=fn):
+            return (x for x in it if _fn(x))
+        return self._transformed(transform, "filter()")
+
+    def batch(self, n: int) -> "ParallelIterator":
+        def transform(it, _n=n):
+            buf = []
+            for x in it:
+                buf.append(x)
+                if len(buf) == _n:
+                    yield buf
+                    buf = []
+            if buf:
+                yield buf
+        return self._transformed(transform, f"batch({n})")
+
+    def flatten(self) -> "ParallelIterator":
+        def transform(it):
+            for x in it:
+                yield from x
+        return self._transformed(transform, "flatten()")
+
+    # -- consumption -----------------------------------------------------
+    def gather_sync(self) -> "LocalIterator":
+        """Round-robin over shards, one item at a time (deterministic)."""
+        def gen():
+            live = collections.deque(self.actors)
+            while live:
+                a = live.popleft()
+                try:
+                    items = ray_tpu.get(a.par_iter_next.remote(1))
+                except Exception:
+                    continue  # shard exhausted
+                yield from items
+                live.append(a)
+        return LocalIterator(gen, name=f"{self.name}.gather_sync()")
+
+    def gather_async(self, batch_ms: int = 0) -> "LocalIterator":
+        """Items in completion order across shards."""
+        def gen():
+            in_flight = {a.par_iter_next.remote(1): a
+                         for a in self.actors}
+            while in_flight:
+                ready, _ = ray_tpu.wait(list(in_flight), num_returns=1)
+                ref = ready[0]
+                actor = in_flight.pop(ref)
+                try:
+                    items = ray_tpu.get(ref)
+                except Exception:
+                    continue
+                in_flight[actor.par_iter_next.remote(1)] = actor
+                yield from items
+        return LocalIterator(gen, name=f"{self.name}.gather_async()")
+
+    def take(self, n: int) -> List:
+        return self.gather_sync().take(n)
+
+    def union(self, other: "ParallelIterator") -> "ParallelIterator":
+        return ParallelIterator(self.actors + other.actors,
+                                f"{self.name}.union({other.name})")
+
+
+class LocalIterator:
+    """Parity: `experimental/iter.py:415` — a chainable local iterator."""
+
+    def __init__(self, gen_fn: Callable[[], Iterator], name="local"):
+        self._gen_fn = gen_fn
+        self.name = name
+
+    def __iter__(self):
+        return iter(self._gen_fn())
+
+    def for_each(self, fn) -> "LocalIterator":
+        return LocalIterator(
+            lambda: (fn(x) for x in self._gen_fn()),
+            name=f"{self.name}.for_each()")
+
+    def filter(self, fn) -> "LocalIterator":
+        return LocalIterator(
+            lambda: (x for x in self._gen_fn() if fn(x)),
+            name=f"{self.name}.filter()")
+
+    def batch(self, n: int) -> "LocalIterator":
+        def gen():
+            buf = []
+            for x in self._gen_fn():
+                buf.append(x)
+                if len(buf) == n:
+                    yield buf
+                    buf = []
+            if buf:
+                yield buf
+        return LocalIterator(gen, name=f"{self.name}.batch({n})")
+
+    def take(self, n: int) -> List:
+        out = []
+        for x in self:
+            out.append(x)
+            if len(out) >= n:
+                break
+        return out

@@ -1,0 +1,95 @@
+"""multiprocessing.Pool shim over tasks.
+
+Parity: `python/ray/experimental/multiprocessing.py` — a drop-in Pool
+with map/map_async/apply/apply_async/imap/starmap running each call as a
+framework task.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional
+
+import ray_tpu
+
+
+class AsyncResult:
+    def __init__(self, refs, single: bool):
+        self._refs = refs
+        self._single = single
+
+    def get(self, timeout=None):
+        values = ray_tpu.get(self._refs, timeout=timeout)
+        return values[0] if self._single else values
+
+    def wait(self, timeout=None):
+        ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                     timeout=timeout)
+
+    def ready(self) -> bool:
+        ready, _ = ray_tpu.wait(self._refs, num_returns=len(self._refs),
+                                timeout=0)
+        return len(ready) == len(self._refs)
+
+    def successful(self) -> bool:
+        try:
+            ray_tpu.get(self._refs, timeout=0.001)
+            return True
+        except Exception:
+            return False
+
+
+class Pool:
+    def __init__(self, processes: Optional[int] = None):
+        if not ray_tpu.is_initialized():
+            ray_tpu.init(num_cpus=processes)
+        self._processes = processes
+
+    def _remote(self, func: Callable):
+        return ray_tpu.remote(lambda *a: func(*a))
+
+    def apply(self, func, args=()):
+        return self.apply_async(func, args).get()
+
+    def apply_async(self, func, args=()) -> AsyncResult:
+        f = self._remote(func)
+        return AsyncResult([f.remote(*args)], single=True)
+
+    def map(self, func, iterable: Iterable) -> List:
+        return self.map_async(func, iterable).get()
+
+    def map_async(self, func, iterable: Iterable) -> AsyncResult:
+        f = self._remote(func)
+        return AsyncResult([f.remote(x) for x in iterable], single=False)
+
+    def imap(self, func, iterable: Iterable):
+        f = self._remote(func)
+        refs = [f.remote(x) for x in iterable]
+        for r in refs:
+            yield ray_tpu.get(r)
+
+    def imap_unordered(self, func, iterable: Iterable):
+        f = self._remote(func)
+        pending = [f.remote(x) for x in iterable]
+        while pending:
+            ready, pending = ray_tpu.wait(pending, num_returns=1)
+            yield ray_tpu.get(ready[0])
+
+    def starmap(self, func, iterable: Iterable) -> List:
+        f = self._remote(func)
+        return ray_tpu.get([f.remote(*args) for args in iterable])
+
+    def close(self):
+        pass
+
+    def join(self):
+        pass
+
+    def terminate(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,78 @@
+"""Distributed FIFO queue backed by an asyncio actor.
+
+Parity: `python/ray/experimental/queue.py` — Queue with
+put/get/qsize/empty/full usable from any worker or the driver.
+"""
+
+from __future__ import annotations
+
+import ray_tpu
+
+
+class _QueueActor:
+    def __init__(self, maxsize: int = 0):
+        import collections
+        self.maxsize = maxsize
+        self._q = collections.deque()
+
+    def put(self, item, block=True) -> bool:
+        if self.maxsize > 0 and len(self._q) >= self.maxsize:
+            return False
+        self._q.append(item)
+        return True
+
+    def get(self):
+        if not self._q:
+            return False, None
+        return True, self._q.popleft()
+
+    def qsize(self) -> int:
+        return len(self._q)
+
+
+class Empty(Exception):
+    pass
+
+
+class Full(Exception):
+    pass
+
+
+class Queue:
+    def __init__(self, maxsize: int = 0):
+        self.maxsize = maxsize
+        self.actor = ray_tpu.remote(_QueueActor).remote(maxsize)
+
+    def put(self, item, block: bool = True, timeout=None):
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            if ray_tpu.get(self.actor.put.remote(item)):
+                return
+            if not block:
+                raise Full()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Full()
+            time.sleep(0.01)
+
+    def get(self, block: bool = True, timeout=None):
+        import time
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            ok, item = ray_tpu.get(self.actor.get.remote())
+            if ok:
+                return item
+            if not block:
+                raise Empty()
+            if deadline is not None and time.monotonic() > deadline:
+                raise Empty()
+            time.sleep(0.01)
+
+    def qsize(self) -> int:
+        return ray_tpu.get(self.actor.qsize.remote())
+
+    def empty(self) -> bool:
+        return self.qsize() == 0
+
+    def full(self) -> bool:
+        return self.maxsize > 0 and self.qsize() >= self.maxsize

@@ -1,0 +1,82 @@
+"""User-level signal pub/sub between tasks/actors.
+
+Parity: `python/ray/experimental/signal.py` — send(signal) from inside a
+task/actor; receive(sources, timeout) polls signals emitted by specific
+actors or task ObjectRefs. Implemented over the head's KV (one ordered
+log per source).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import List, Tuple
+
+import cloudpickle
+
+import ray_tpu
+from ray_tpu._private import worker_state as _ws
+
+
+class Signal:
+    pass
+
+
+class ErrorSignal(Signal):
+    def __init__(self, error):
+        self.error = error
+
+
+class DoneSignal(Signal):
+    pass
+
+
+def _source_key(source) -> str:
+    if hasattr(source, "_actor_id"):  # ActorHandle
+        return "signal:" + source._actor_id.hex()
+    if hasattr(source, "id"):  # ObjectRef -> keyed by task
+        return "signal:" + source.id.task_id().hex()
+    raise TypeError(f"bad signal source {source!r}")
+
+
+def _self_key() -> str:
+    rt = _ws.get_runtime()
+    actor = getattr(rt, "_actor", None)
+    if actor is not None:
+        return "signal:" + actor.spec.actor_id.hex()
+    return "signal:driver-" + rt.addr
+
+
+def send(signal: Signal) -> None:
+    rt = _ws.get_runtime()
+    key = _self_key()
+    reply = rt.head.request({"kind": "kv_get", "key": key}, timeout=30)
+    log = cloudpickle.loads(reply["value"]) if reply["value"] else []
+    log.append(signal)
+    rt.head.request({"kind": "kv_put", "key": key,
+                     "value": cloudpickle.dumps(log)}, timeout=30)
+
+
+def receive(sources: List, timeout: float = None
+            ) -> List[Tuple[object, Signal]]:
+    """Returns [(source, signal)] for signals not yet consumed by this
+    receiver."""
+    rt = _ws.get_runtime()
+    if not hasattr(rt, "_signal_cursors"):
+        rt._signal_cursors = {}
+    deadline = None if timeout is None else time.monotonic() + timeout
+    while True:
+        out = []
+        for source in sources:
+            key = _source_key(source)
+            reply = rt.head.request({"kind": "kv_get", "key": key},
+                                    timeout=30)
+            log = cloudpickle.loads(reply["value"]) \
+                if reply["value"] else []
+            cursor = rt._signal_cursors.get((id(source), key), 0)
+            for sig in log[cursor:]:
+                out.append((source, sig))
+            rt._signal_cursors[(id(source), key)] = len(log)
+        if out or (deadline is not None
+                   and time.monotonic() >= deadline):
+            return out
+        time.sleep(0.02)

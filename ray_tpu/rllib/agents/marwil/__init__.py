@@ -1,0 +1,3 @@
+from .marwil import DEFAULT_CONFIG, MARWILJaxPolicy, MARWILTrainer
+
+__all__ = ["DEFAULT_CONFIG", "MARWILJaxPolicy", "MARWILTrainer"]

@@ -1,0 +1,94 @@
+"""MARWIL: Monotonic Advantage Re-Weighted Imitation Learning.
+
+Parity: `rllib/agents/marwil/marwil.py` + `marwil_policy.py` —
+advantage-weighted behavior cloning, usable purely offline (`input`
+pointing at recorded experience) or online. beta=0 degenerates to plain
+behavior cloning. The reference tracks a moving average of the squared
+advantage norm in a TF variable; here it lives in the policy's
+loss_state and updates after every optimizer step (same semantics,
+explicit state).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ... import sample_batch as sb
+from ...policy.jax_policy_template import build_jax_policy
+from ..trainer import with_common_config
+from ..trainer_template import build_trainer
+
+DEFAULT_CONFIG = with_common_config({
+    # 0 = behavior cloning; >0 weights by exp(beta * standardized adv).
+    "beta": 1.0,
+    "vf_coeff": 1.0,
+    "moving_average_sqd_adv_norm_update_rate": 1e-8,
+    "lr": 1e-4,
+    "gamma": 0.99,
+    "train_batch_size": 2000,
+    "rollout_fragment_length": 200,
+    # MC returns, not GAE (reference: postprocess_advantages with
+    # use_gae=False -> value_targets are discounted returns).
+    "use_gae": False,
+    "use_critic": False,
+    "loss_state": {"ma_adv_norm": 100.0},
+})
+
+
+def marwil_loss(policy, params, batch, rng, loss_state):
+    cfg = policy.config
+    dist_inputs, value = policy.apply_batch(params, batch)
+    dist = policy.dist_class(dist_inputs)
+    logp = dist.logp(batch[sb.ACTIONS])
+
+    # value_targets = discounted episode returns (use_gae=False path)
+    returns = batch[sb.VALUE_TARGETS]
+    adv = returns - value
+    vf_loss = jnp.mean(adv ** 2)
+
+    beta = cfg["beta"]
+    if beta != 0.0:
+        ma_norm = loss_state.get("ma_adv_norm", jnp.float32(100.0))
+        exp_adv = jnp.exp(
+            beta * jax.lax.stop_gradient(adv)
+            / (1e-8 + jnp.sqrt(ma_norm)))
+        # cap the weights (reference clamps the exponentiated advantage)
+        weights = jnp.minimum(exp_adv, 20.0)
+    else:
+        weights = jnp.ones_like(logp)
+    policy_loss = -jnp.mean(weights * logp)
+
+    total = policy_loss + cfg["vf_coeff"] * vf_loss
+    stats = {
+        "total_loss": total,
+        "policy_loss": policy_loss,
+        "vf_loss": vf_loss,
+        "mean_advantage": jnp.mean(adv),
+        "sqd_adv_norm": jnp.mean(adv ** 2),
+    }
+    return total, stats
+
+
+def update_ma_norm(trainer, fetches):
+    """Update the moving average of the squared advantage norm
+    (reference: marwil_policy's MovingAverage update op)."""
+    if "sqd_adv_norm" not in fetches:
+        return
+    policy = trainer.get_policy()
+    rate = trainer.config["moving_average_sqd_adv_norm_update_rate"]
+    old = float(policy.loss_state.get("ma_adv_norm", 100.0))
+    new = old + rate * (fetches["sqd_adv_norm"] - old)
+    policy.update_loss_state(ma_adv_norm=new)
+
+
+MARWILJaxPolicy = build_jax_policy(
+    "MARWILJaxPolicy", marwil_loss,
+    get_default_config=lambda: DEFAULT_CONFIG)
+
+
+MARWILTrainer = build_trainer(
+    name="MARWIL",
+    default_policy=MARWILJaxPolicy,
+    default_config=DEFAULT_CONFIG,
+    after_optimizer_step=update_ma_norm)

@@ -76,6 +76,11 @@ def _ars():
     return ARSTrainer
 
 
+def _marwil():
+    from .marwil import MARWILTrainer
+    return MARWILTrainer
+
+
 ALGORITHMS = {
     "PG": _pg,
     "PPO": _ppo,
@@ -92,6 +97,7 @@ ALGORITHMS = {
     "APPO": _appo,
     "ES": _es,
     "ARS": _ars,
+    "MARWIL": _marwil,
 }
 
 

@@ -1,0 +1,81 @@
+"""ExternalEnv: environments that drive the policy (not vice versa).
+
+Parity: `rllib/env/external_env.py` — for simulators/services that call
+INTO the agent: the user's `run()` loop calls `start_episode` /
+`get_action(obs)` / `log_returns(reward)` / `end_episode(obs)`, while
+the framework polls completed steps out. The reference runs `run()` on a
+thread and bridges through queues; this implementation does the same and
+adapts it to the standard Env interface so any trainer can consume an
+ExternalEnv unchanged (the sampler steps the adapter, the adapter
+exchanges obs/actions with the user loop).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import uuid
+from typing import Optional
+
+import numpy as np
+
+
+class ExternalEnv(threading.Thread):
+    def __init__(self, observation_space, action_space):
+        super().__init__(daemon=True, name="external-env-run")
+        self.observation_space = observation_space
+        self.action_space = action_space
+        # user loop -> framework: (kind, payload)
+        self._obs_q: "queue.Queue" = queue.Queue(1)
+        # framework -> user loop: actions
+        self._action_q: "queue.Queue" = queue.Queue(1)
+        self._episode_reward = 0.0
+        self._loop_started = False
+
+    # -- user-side API (called from run()) -------------------------------
+    def run(self):
+        raise NotImplementedError
+
+    def start_episode(self, episode_id: Optional[str] = None) -> str:
+        self._episode_reward = 0.0
+        return episode_id or uuid.uuid4().hex
+
+    def get_action(self, episode_id: str, observation):
+        """Block until the policy provides an action for `observation`."""
+        self._obs_q.put(("obs", observation, self._take_reward()))
+        return self._action_q.get()
+
+    def log_returns(self, episode_id: str, reward: float):
+        self._episode_reward += float(reward)
+
+    def end_episode(self, episode_id: str, observation):
+        self._obs_q.put(("done", observation, self._take_reward()))
+
+    def _take_reward(self) -> float:
+        r = self._episode_reward
+        self._episode_reward = 0.0
+        return r
+
+    # -- framework-side adapter (standard Env interface) -----------------
+    def reset(self):
+        if not self._loop_started:
+            self._loop_started = True
+            self.start()
+        kind, obs, _ = self._obs_q.get()
+        # an immediate 'done' (empty episode) is skipped
+        while kind == "done":
+            kind, obs, _ = self._obs_q.get()
+        self._pending_obs = obs
+        return obs
+
+    def step(self, action):
+        self._action_q.put(action)
+        kind, obs, reward = self._obs_q.get()
+        done = kind == "done"
+        return obs, reward, done, {}
+
+    def close(self):
+        pass
+
+    def seed(self, seed=None):
+        pass

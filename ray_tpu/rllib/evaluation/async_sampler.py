@@ -1,0 +1,58 @@
+"""AsyncSampler: environment stepping on a background thread.
+
+Parity: `rllib/evaluation/sampler.py:121` (AsyncSampler) — the env loop
+runs in its own thread pushing fragments into a bounded queue;
+`sample()` just drains it. Used when env stepping is slow/blocking
+(e.g. ExternalEnv-style setups) so the trainer thread never stalls in
+`env.step`.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+from ..sample_batch import SampleBatch
+from .sampler import SyncSampler
+
+
+class AsyncSampler:
+    """Wraps a SyncSampler, running its sample loop on a daemon thread."""
+
+    def __init__(self, *args, queue_size: int = 4, **kwargs):
+        self._inner = SyncSampler(*args, **kwargs)
+        self._queue: "queue.Queue[SampleBatch]" = queue.Queue(queue_size)
+        self._error: Optional[BaseException] = None
+        self._stopped = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="async-sampler")
+        self._thread.start()
+
+    def _run(self):
+        try:
+            while not self._stopped.is_set():
+                batch = self._inner.sample()
+                while not self._stopped.is_set():
+                    try:
+                        self._queue.put(batch, timeout=0.5)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — surfaced on sample()
+            self._error = e
+
+    def sample(self) -> SampleBatch:
+        while True:
+            if self._error is not None:
+                raise self._error
+            try:
+                return self._queue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+
+    def get_metrics(self):
+        return self._inner.get_metrics()
+
+    def stop(self):
+        self._stopped.set()

@@ -96,7 +96,13 @@ class RolloutWorker:
                     chunk, self.policy.train_seq_len)
             return chunk
 
-        self.sampler = SyncSampler(
+        # sample_async runs the env loop on a background thread
+        # (parity: `sampler.py:121` AsyncSampler, A3C's default).
+        sampler_cls = SyncSampler
+        if policy_config.get("sample_async"):
+            from .async_sampler import AsyncSampler
+            sampler_cls = AsyncSampler
+        self.sampler = sampler_cls(
             self.env, self.policy, rollout_fragment_length,
             # Packed fragments (IMPALA/V-trace) compute targets on the
             # learner; GAE postprocessing only applies to episode chunks.
@@ -282,6 +288,8 @@ class RolloutWorker:
         return "ok"
 
     def stop(self):
+        if hasattr(self.sampler, "stop"):
+            self.sampler.stop()
         if self.env is not None:
             self.env.envs and [e.close() for e in self.env.envs]
         elif self.policy_map is not None:

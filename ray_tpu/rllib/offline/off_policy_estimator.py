@@ -72,20 +72,30 @@ class ImportanceSamplingEstimator(OffPolicyEstimator):
 
 
 class WeightedImportanceSamplingEstimator(OffPolicyEstimator):
-    """Parity: `rllib/offline/wis_estimator.py` — IS normalized by the
-    running mean of the cumulative importance weights."""
+    """Parity: `rllib/offline/wis_estimator.py` — each timestep's
+    cumulative importance weight p[t] is normalized by the running mean
+    of p[t] at that SAME timestep index across episodes (per-step
+    normalization, not the episode-final weight)."""
+
+    def __init__(self, policy, gamma: float = 0.99):
+        super().__init__(policy, gamma)
+        self._pt_sums: list = []   # running sum of p[t] per step index
+        self._pt_count = 0
 
     def estimate(self, episode: SampleBatch) -> OffPolicyEstimate:
         rewards, rho = self._rewards_and_rho(episode)
         p = np.cumprod(rho)
-        self._rho_sum += float(p[-1])
-        self._rho_count += 1
-        w_bar = self._rho_sum / self._rho_count
+        while len(self._pt_sums) < len(p):
+            self._pt_sums.append(0.0)
+        for t in range(len(p)):
+            self._pt_sums[t] += float(p[t])
+        self._pt_count += 1
         v_old = 0.0
         v_new = 0.0
         for t in range(len(rewards)):
+            w_bar_t = self._pt_sums[t] / self._pt_count
             v_old += rewards[t] * self.gamma ** t
-            v_new += (p[t] / max(1e-8, w_bar)) * rewards[t] \
+            v_new += (p[t] / max(1e-8, w_bar_t)) * rewards[t] \
                 * self.gamma ** t
         return OffPolicyEstimate("wis", {
             "V_prev": float(v_old),

@@ -4,12 +4,18 @@ Parity: `rllib/optimizers/segment_tree.py` (SumSegmentTree, MinSegmentTree)
 — re-designed host-vectorized: all updates and prefix-sum queries operate on
 whole index *batches* with numpy (one O(log n) vectorized sweep per level),
 because the TPU-side learner consumes minibatches, so the host never needs
-per-item tree ops.
+per-item tree ops. When the native library is available
+(`ray_tpu/_native/segment_tree.cpp`), updates and inverse-CDF sampling run
+in C++ directly on the numpy buffer — the Ape-X replay-shard hot loops.
 """
 
 from __future__ import annotations
 
+import ctypes
+
 import numpy as np
+
+from ..._native import segment_tree_lib
 
 
 class SegmentTree:
@@ -28,12 +34,26 @@ class SegmentTree:
         self._op = operation
         self._neutral = neutral
         self._tree = np.full(2 * size, neutral, dtype=np.float64)
+        self._native = segment_tree_lib()
+        self._native_op = 0 if operation is np.add else 1
+
+    def _tree_ptr(self):
+        return self._tree.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
 
     # -- updates ---------------------------------------------------------
     def set_items(self, idxs, values) -> None:
-        """Set leaves at `idxs` (vectorized) and repair ancestors."""
-        idxs = np.asarray(idxs, dtype=np.int64) + self._size
-        self._tree[idxs] = np.asarray(values, dtype=np.float64)
+        """Set leaves at `idxs` and repair ancestors."""
+        idxs = np.ascontiguousarray(idxs, dtype=np.int64)
+        values = np.ascontiguousarray(values, dtype=np.float64)
+        if self._native is not None:
+            self._native.st_set_items(
+                self._tree_ptr(), self._size,
+                idxs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                values.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                len(idxs), self._native_op)
+            return
+        idxs = idxs + self._size
+        self._tree[idxs] = values
         parents = np.unique(idxs // 2)
         while parents.size and parents[0] >= 1:
             self._tree[parents] = self._op(
@@ -63,9 +83,18 @@ class SumSegmentTree(SegmentTree):
         return self.reduce_all()
 
     def find_prefixsum_idx(self, prefixsums) -> np.ndarray:
-        """Vectorized: for each p, the smallest leaf i with
-        cumsum(leaves[0..i]) > p. Descends all queries one level at a
-        time (log n numpy steps total, independent of batch size)."""
+        """For each p, the smallest leaf i with cumsum(leaves[0..i]) > p.
+        Native path descends per query in C++; numpy fallback descends
+        all queries one level at a time (log n vectorized steps)."""
+        if self._native is not None:
+            p = np.ascontiguousarray(prefixsums, dtype=np.float64)
+            out = np.empty(len(p), dtype=np.int64)
+            self._native.st_find_prefixsum(
+                self._tree_ptr(), self._size, self.capacity,
+                p.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                len(p))
+            return out
         p = np.asarray(prefixsums, dtype=np.float64).copy()
         idx = np.ones(len(p), dtype=np.int64)
         while idx[0] < self._size:  # all idx are at the same level

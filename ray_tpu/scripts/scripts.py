@@ -50,14 +50,15 @@ def _connect(address: str):
 def cmd_start(args):
     if args.head:
         from ray_tpu._private import node as node_mod
-        resources = {}
+        # Merge explicit flags over detected defaults (a bare
+        # --num-tpus must not zero out the CPU resource).
+        resources = node_mod.default_resources()
         if args.num_cpus is not None:
             resources["CPU"] = float(args.num_cpus)
         if args.num_tpus is not None:
             resources["TPU"] = float(args.num_tpus)
         node = node_mod.Node(
-            resources or node_mod.default_resources(),
-            num_initial_workers=0, enable_tcp=True)
+            resources, num_initial_workers=0, enable_tcp=True)
         _record_pid("head")
         os.makedirs(PID_DIR, exist_ok=True)
         with open(ADDRESS_FILE, "w") as f:

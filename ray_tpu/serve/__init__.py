@@ -1,0 +1,5 @@
+from .api import (create_backend, create_endpoint, get_handle, init, link,
+                  set_traffic, shutdown)
+
+__all__ = ["create_backend", "create_endpoint", "get_handle", "init",
+           "link", "set_traffic", "shutdown"]

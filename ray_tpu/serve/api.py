@@ -1,0 +1,202 @@
+"""Model serving on actors with an HTTP frontend.
+
+Parity: `python/ray/experimental/serve/api.py:62` — `init`,
+`create_backend` (:204), `create_endpoint` (:137), `set_traffic`,
+`get_handle`; backends are replica actors, endpoints route HTTP and
+Python calls to backends by traffic weights (reference: router queues in
+`serve/queues.py` + flask frontend in `serve/server.py`; here the
+router is one actor embedding a stdlib HTTP server thread, and replica
+fan-out uses round-robin over actor handles).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, Optional
+
+import ray_tpu
+
+_router = None
+
+
+class _Replica:
+    """Hosts one backend replica (a function or a class instance)."""
+
+    def __init__(self, func_or_class_bytes, args, kwargs):
+        import cloudpickle
+        target = cloudpickle.loads(func_or_class_bytes)
+        if isinstance(target, type):
+            self._callable = target(*args, **kwargs)
+        else:
+            self._callable = target
+
+    def handle(self, request):
+        c = self._callable
+        if callable(c):
+            return c(request)
+        return c.__call__(request)
+
+
+class _Router:
+    """Endpoint/backend tables + HTTP frontend (one per serve instance)."""
+
+    def __init__(self, http_host: str, http_port: int):
+        self.endpoints: Dict[str, dict] = {}   # name -> {route, traffic}
+        self.backends: Dict[str, list] = {}    # name -> [replica handles]
+        self.routes: Dict[str, str] = {}       # route -> endpoint
+        self._rr: Dict[str, int] = {}
+        self._http_addr = None
+        self._start_http(http_host, http_port)
+
+    # -- control plane ---------------------------------------------------
+    def create_endpoint(self, name: str, route: Optional[str]):
+        self.endpoints[name] = {"route": route, "traffic": {}}
+        if route:
+            self.routes[route] = name
+        return "ok"
+
+    def create_backend(self, name: str, func_or_class_bytes, args,
+                       kwargs, num_replicas: int):
+        cls = ray_tpu.remote(_Replica)
+        self.backends[name] = [
+            cls.remote(func_or_class_bytes, list(args), dict(kwargs))
+            for _ in range(num_replicas)]
+        return "ok"
+
+    def set_traffic(self, endpoint: str, traffic: Dict[str, float]):
+        total = sum(traffic.values())
+        self.endpoints[endpoint]["traffic"] = {
+            b: w / total for b, w in traffic.items()}
+        return "ok"
+
+    def http_address(self):
+        return self._http_addr
+
+    # -- data plane ------------------------------------------------------
+    def _pick_backend(self, endpoint: str) -> str:
+        import random
+        traffic = self.endpoints[endpoint]["traffic"]
+        if not traffic:
+            raise ValueError(f"endpoint {endpoint!r} has no traffic")
+        r = random.random()
+        acc = 0.0
+        for backend, w in traffic.items():
+            acc += w
+            if r <= acc:
+                return backend
+        return next(iter(traffic))
+
+    def route_call(self, endpoint: str, request):
+        backend = self._pick_backend(endpoint)
+        replicas = self.backends[backend]
+        i = self._rr.get(backend, 0)
+        self._rr[backend] = (i + 1) % len(replicas)
+        return ray_tpu.get(replicas[i].handle.remote(request))
+
+    # -- HTTP frontend ---------------------------------------------------
+    def _start_http(self, host: str, port: int):
+        import threading
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        router = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def _serve(self, body):
+                endpoint = router.routes.get(self.path)
+                if endpoint is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "no such route"}')
+                    return
+                try:
+                    result = router.route_call(endpoint, body)
+                    payload = json.dumps({"result": result}).encode()
+                    self.send_response(200)
+                except Exception as e:  # noqa: BLE001 — surface to client
+                    payload = json.dumps({"error": str(e)}).encode()
+                    self.send_response(500)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def do_GET(self):
+                self._serve(None)
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) if n else b""
+                try:
+                    body = json.loads(raw) if raw else None
+                except json.JSONDecodeError:
+                    body = raw.decode(errors="replace")
+                self._serve(body)
+
+            def log_message(self, *a):
+                pass
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._http_addr = \
+            f"http://{host}:{self._httpd.server_address[1]}"
+        threading.Thread(target=self._httpd.serve_forever, daemon=True,
+                         name="serve-http").start()
+
+
+def init(http_host: str = "127.0.0.1", http_port: int = 0) -> str:
+    """Start the serve instance; returns the HTTP address."""
+    global _router
+    if _router is None:
+        _router = ray_tpu.remote(_Router).options(
+            max_concurrency=16).remote(http_host, http_port)
+    return ray_tpu.get(_router.http_address.remote())
+
+
+def _require_router():
+    if _router is None:
+        raise RuntimeError("serve.init() has not been called")
+    return _router
+
+
+def create_endpoint(name: str, route: Optional[str] = None):
+    ray_tpu.get(_require_router().create_endpoint.remote(name, route))
+
+
+def create_backend(name: str, func_or_class: Callable, *args,
+                   num_replicas: int = 1, **kwargs):
+    import cloudpickle
+    ray_tpu.get(_require_router().create_backend.remote(
+        name, cloudpickle.dumps(func_or_class), args, kwargs,
+        num_replicas))
+
+
+def set_traffic(endpoint: str, traffic: Dict[str, float]):
+    ray_tpu.get(_require_router().set_traffic.remote(endpoint, traffic))
+
+
+def link(endpoint: str, backend: str):
+    """Route 100% of an endpoint to one backend (reference api.link)."""
+    set_traffic(endpoint, {backend: 1.0})
+
+
+class RayServeHandle:
+    """Python-side endpoint handle (reference: `serve/handle.py`)."""
+
+    def __init__(self, router, endpoint: str):
+        self._router = router
+        self._endpoint = endpoint
+
+    def remote(self, request: Any = None):
+        return self._router.route_call.remote(self._endpoint, request)
+
+
+def get_handle(endpoint: str) -> RayServeHandle:
+    return RayServeHandle(_require_router(), endpoint)
+
+
+def shutdown():
+    global _router
+    if _router is not None:
+        try:
+            ray_tpu.kill(_router)
+        except Exception:
+            pass
+        _router = None

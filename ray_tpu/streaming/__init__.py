@@ -1,0 +1,3 @@
+from .streaming import DataStream, ExecutionGraph, StreamingContext
+
+__all__ = ["DataStream", "ExecutionGraph", "StreamingContext"]

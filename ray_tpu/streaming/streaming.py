@@ -1,0 +1,181 @@
+"""Streaming: operator DAGs executed as actor pipelines.
+
+Parity: `streaming/python/streaming.py` (`ExecutionGraph`, operators,
+actor channels over the C++ data plane N27) — the API surface
+(StreamingContext -> source -> map/flat_map/filter/key_by/reduce/sink)
+compiles to a chain of operator actors connected by ordered actor calls
+(the framework's actor streams ARE the channel layer: per-caller
+sequence numbers give the same ordered-delivery guarantee the
+reference's ring-buffer channels provide). key_by hash-partitions items
+across the downstream operator's parallel instances.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import ray_tpu
+
+
+def _stable_hash(key) -> int:
+    import hashlib
+    return int.from_bytes(
+        hashlib.md5(repr(key).encode()).digest()[:8], "little")
+
+
+class _OperatorActor:
+    """One parallel instance of one operator stage."""
+
+    def __init__(self, kind: str, fn_bytes, downstream_handles,
+                 instance_id: int):
+        import cloudpickle
+        self.kind = kind
+        self.fn = cloudpickle.loads(fn_bytes) if fn_bytes else None
+        self.downstream = downstream_handles
+        self.instance_id = instance_id
+        self._state: Dict[Any, Any] = {}  # key -> accumulated value
+        self._sink: List[Any] = []
+        self._rr = 0
+
+    # -- data plane ------------------------------------------------------
+    def process(self, item, key=None):
+        if self.kind == "map":
+            self._emit(self.fn(item), key)
+        elif self.kind == "flat_map":
+            for out in self.fn(item):
+                self._emit(out, key)
+        elif self.kind == "filter":
+            if self.fn(item):
+                self._emit(item, key)
+        elif self.kind == "key_by":
+            self._emit(item, self.fn(item))
+        elif self.kind == "reduce":
+            if key in self._state:
+                self._state[key] = self.fn(self._state[key], item)
+            else:
+                self._state[key] = item
+            self._emit((key, self._state[key]), key)
+        elif self.kind == "sink":
+            self._sink.append(self.fn(item) if self.fn else item)
+        return None
+
+    def _emit(self, item, key):
+        if not self.downstream:
+            return
+        if key is not None:
+            # Stable cross-process hash: Python's hash() is salted per
+            # process, which would scatter one key over partitions.
+            i = _stable_hash(key) % len(self.downstream)
+        else:
+            i = self._rr
+            self._rr = (self._rr + 1) % len(self.downstream)
+        # Fire-and-forget ordered actor call (the channel push).
+        self.downstream[i].process.remote(item, key)
+
+    # -- control ---------------------------------------------------------
+    def flush(self):
+        """Recursive barrier riding the data channels: this call is
+        ordered after every push its caller made, and it returns only
+        when the whole downstream DAG has flushed — so when the DRIVER's
+        flush of the source stage returns, every item has fully
+        propagated (the reference's channel flush semantics)."""
+        import ray_tpu as _ray
+        if self.downstream:
+            _ray.get([d.flush.remote() for d in self.downstream])
+        return "ok"
+
+    def sink_values(self):
+        return list(self._sink)
+
+    def reduce_state(self):
+        return dict(self._state)
+
+
+class DataStream:
+    def __init__(self, ctx: "StreamingContext", stages: List[dict]):
+        self._ctx = ctx
+        self._stages = stages
+
+    def _with(self, kind: str, fn: Optional[Callable],
+              parallelism: int = 1) -> "DataStream":
+        return DataStream(self._ctx, self._stages + [
+            {"kind": kind, "fn": fn, "parallelism": parallelism}])
+
+    def map(self, fn, parallelism: int = 1):
+        return self._with("map", fn, parallelism)
+
+    def flat_map(self, fn, parallelism: int = 1):
+        return self._with("flat_map", fn, parallelism)
+
+    def filter(self, fn, parallelism: int = 1):
+        return self._with("filter", fn, parallelism)
+
+    def key_by(self, fn, parallelism: int = 1):
+        return self._with("key_by", fn, parallelism)
+
+    def reduce(self, fn, parallelism: int = 1):
+        return self._with("reduce", fn, parallelism)
+
+    def sum(self, parallelism: int = 1):
+        return self.reduce(lambda a, b: a + b, parallelism)
+
+    def sink(self, fn: Optional[Callable] = None):
+        return self._with("sink", fn, 1)
+
+    def execute(self) -> "ExecutionGraph":
+        return self._ctx._execute(self._stages)
+
+
+class ExecutionGraph:
+    """A materialized pipeline (parity: `streaming.py:46`)."""
+
+    def __init__(self, stage_actors: List[List], source_items):
+        self.stage_actors = stage_actors
+        self._source_items = source_items
+
+    def run(self):
+        """Push every source item through, then flush the DAG."""
+        first = self.stage_actors[0]
+        for i, item in enumerate(self._source_items):
+            first[i % len(first)].process.remote(item)
+        ray_tpu.get([a.flush.remote() for a in first])
+        return self
+
+    def sink_values(self) -> List:
+        out = []
+        for a in self.stage_actors[-1]:
+            out.extend(ray_tpu.get(a.sink_values.remote()))
+        return out
+
+    def reduce_state(self) -> Dict:
+        merged: Dict = {}
+        for stage in self.stage_actors:
+            for a in stage:
+                merged.update(ray_tpu.get(a.reduce_state.remote()))
+        return merged
+
+
+class StreamingContext:
+    def __init__(self):
+        self._cls = ray_tpu.remote(_OperatorActor)
+
+    def from_collection(self, items) -> DataStream:
+        self._items = list(items)
+        return DataStream(self, [])
+
+    def _execute(self, stages: List[dict]) -> ExecutionGraph:
+        import cloudpickle
+        # Build actor stages back-to-front so each knows its downstream.
+        stage_actors: List[List] = []
+        downstream: List = []
+        for spec in reversed(stages):
+            fn_bytes = cloudpickle.dumps(spec["fn"]) if spec["fn"] \
+                else None
+            actors = [
+                self._cls.remote(spec["kind"], fn_bytes, downstream, i)
+                for i in range(max(1, spec["parallelism"]))]
+            stage_actors.insert(0, actors)
+            downstream = actors
+        if not stage_actors:
+            raise ValueError("empty pipeline")
+        return ExecutionGraph(stage_actors, self._items)

@@ -101,3 +101,49 @@ class TestARS:
                 break
         t.stop()
         assert best >= 120, f"ARS failed to improve on CartPole: {best}"
+
+
+class TestMARWIL:
+    def test_marwil_offline_bc(self, tmp_path):
+        """Record experience with PG, then MARWIL (beta=0 -> behavior
+        cloning) trains purely from the files, no env stepping."""
+        import glob
+        import os
+        from ray_tpu.rllib.agents.pg import PGTrainer
+        from ray_tpu.rllib.agents.marwil import MARWILTrainer
+        out_dir = str(tmp_path / "exp")
+        t = PGTrainer(config={
+            "env": "CartPole-v0", "num_workers": 0,
+            "train_batch_size": 256, "rollout_fragment_length": 128,
+            "output": out_dir, "seed": 0,
+        })
+        for _ in range(3):
+            t.train()
+        t.stop()
+        assert glob.glob(os.path.join(out_dir, "*.json"))
+
+        m = MARWILTrainer(config={
+            "env": "CartPole-v0", "num_workers": 0,
+            "input": out_dir, "train_batch_size": 256,
+            "beta": 1.0, "seed": 0,
+        })
+        r = m.train()
+        assert r["timesteps_this_iter"] >= 256
+        assert "policy_loss" in r["info"]["learner"]
+        m.stop()
+
+    def test_marwil_online_learns(self):
+        from ray_tpu.rllib.agents.marwil import MARWILTrainer
+        t = MARWILTrainer(config={
+            "env": "CartPole-v0", "num_workers": 0,
+            "train_batch_size": 512, "rollout_fragment_length": 128,
+            "beta": 1.0, "lr": 3e-4, "seed": 0,
+        })
+        best = 0
+        for _ in range(30):
+            r = t.train()
+            best = max(best, r["episode_reward_mean"])
+            if best >= 60:
+                break
+        t.stop()
+        assert best >= 60, f"MARWIL failed to improve: {best}"

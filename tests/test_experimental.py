@@ -1,0 +1,114 @@
+"""Experimental utilities (parity: `python/ray/experimental/`)."""
+
+import asyncio
+
+import pytest
+
+import ray_tpu
+
+
+class TestParallelIterator:
+    def test_from_items_transform_gather(self, ray_start):
+        from ray_tpu.experimental import from_items
+        it = from_items(list(range(10)), num_shards=2)
+        result = sorted(it.for_each(lambda x: x * 2)
+                        .filter(lambda x: x % 4 == 0)
+                        .gather_sync().take(10))
+        assert result == [0, 4, 8, 12, 16]
+
+    def test_batch_and_async(self, ray_start):
+        from ray_tpu.experimental import from_range
+        it = from_range(8, num_shards=2).batch(2)
+        batches = it.gather_async().take(4)
+        assert len(batches) == 4
+        assert sorted(x for b in batches for x in b) == list(range(8))
+
+
+class TestActorPool:
+    def test_map_ordered_and_unordered(self, ray_start):
+        @ray_tpu.remote
+        class Worker:
+            def double(self, x):
+                return x * 2
+
+        from ray_tpu.experimental import ActorPool
+        pool = ActorPool([Worker.remote() for _ in range(2)])
+        assert list(pool.map(lambda a, v: a.double.remote(v),
+                             [1, 2, 3, 4])) == [2, 4, 6, 8]
+        assert sorted(pool.map_unordered(
+            lambda a, v: a.double.remote(v), [1, 2, 3])) == [2, 4, 6]
+
+
+class TestQueue:
+    def test_put_get(self, ray_start):
+        from ray_tpu.experimental import Empty, Queue
+        q = Queue(maxsize=4)
+        q.put("a")
+        q.put("b")
+        assert q.qsize() == 2
+        assert q.get() == "a"
+        assert q.get() == "b"
+        with pytest.raises(Empty):
+            q.get(block=False)
+
+    def test_queue_across_tasks(self, ray_start):
+        from ray_tpu.experimental import Queue
+        q = Queue()
+
+        @ray_tpu.remote
+        def producer(q):
+            for i in range(3):
+                q.put(i)
+            return "done"
+
+        assert ray_tpu.get(producer.remote(q)) == "done"
+        assert [q.get(timeout=10) for _ in range(3)] == [0, 1, 2]
+
+
+class TestPool:
+    def test_map_and_apply(self, ray_start):
+        from ray_tpu.experimental import Pool
+        with Pool() as p:
+            assert p.map(lambda x: x + 1, range(5)) == [1, 2, 3, 4, 5]
+            assert p.apply(lambda a, b: a * b, (3, 4)) == 12
+            assert sorted(p.imap_unordered(lambda x: x * 10,
+                                           [1, 2, 3])) == [10, 20, 30]
+            assert p.starmap(lambda a, b: a + b,
+                             [(1, 2), (3, 4)]) == [3, 7]
+
+
+class TestAsyncBridge:
+    def test_as_future(self, ray_start):
+        from ray_tpu.experimental import as_future
+
+        @ray_tpu.remote
+        def f():
+            return 41
+
+        async def main():
+            return await as_future(f.remote()) + 1
+
+        loop = asyncio.new_event_loop()
+        try:
+            assert loop.run_until_complete(main()) == 42
+        finally:
+            loop.close()
+
+
+class TestSignals:
+    def test_actor_signals(self, ray_start):
+        from ray_tpu.experimental import signal as sig
+
+        @ray_tpu.remote
+        class Emitter:
+            def emit(self, n):
+                from ray_tpu.experimental import signal as s
+                for i in range(n):
+                    s.send(s.DoneSignal())
+                return "ok"
+
+        e = Emitter.remote()
+        ray_tpu.get(e.emit.remote(2))
+        got = sig.receive([e], timeout=10)
+        assert len(got) == 2
+        assert all(isinstance(s, sig.DoneSignal) for _, s in got)
