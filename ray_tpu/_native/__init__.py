@@ -16,7 +16,6 @@ import hashlib
 import logging
 import os
 import subprocess
-import tempfile
 import threading
 from typing import Optional
 
@@ -31,13 +30,21 @@ def native_enabled() -> bool:
     return os.environ.get("RAY_TPU_NATIVE", "1") != "0"
 
 
+def _cache_dir() -> str:
+    # User-owned cache, NOT the world-writable temp dir: a predictable
+    # /tmp path could be pre-seeded with a hostile .so by another user.
+    d = os.environ.get("RAY_TPU_NATIVE_CACHE") or os.path.join(
+        os.path.expanduser("~"), ".cache", "ray_tpu_native")
+    os.makedirs(d, mode=0o700, exist_ok=True)
+    return d
+
+
 def _build(src_path: str) -> Optional[str]:
     """Compile `src_path` to a cached .so; returns the path or None."""
     with open(src_path, "rb") as f:
         digest = hashlib.sha256(f.read()).hexdigest()[:16]
     base = os.path.basename(src_path).rsplit(".", 1)[0]
-    out = os.path.join(tempfile.gettempdir(),
-                       f"ray_tpu_native_{base}_{digest}.so")
+    out = os.path.join(_cache_dir(), f"{base}_{digest}.so")
     if os.path.exists(out):
         return out
     tmp = out + f".build{os.getpid()}"

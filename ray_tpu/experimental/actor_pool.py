@@ -54,9 +54,10 @@ class ActorPool:
         """Next result in SUBMISSION order."""
         if not self.has_next():
             raise StopIteration("no pending results")
-        ref = self._index_to_future.pop(self._next_return_index)
+        ref = self._index_to_future[self._next_return_index]
+        value = ray_tpu.get(ref, timeout=timeout)  # may time out: retryable
+        del self._index_to_future[self._next_return_index]
         self._next_return_index += 1
-        value = ray_tpu.get(ref, timeout=timeout)
         self._recycle(ref)
         del self._future_to_actor[ref]
         return value

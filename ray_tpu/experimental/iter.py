@@ -16,12 +16,24 @@ import ray_tpu
 
 
 class _ShardActor:
-    """Hosts one shard's item stream and applies queued transforms."""
+    """Hosts one shard's source; each gather begins a fresh pipeline, so
+    branching iterators (base.filter(a) and base.filter(b)) never
+    contaminate each other's transforms."""
 
     def __init__(self, make_items):
-        self._it = iter(make_items())
+        self._make_items = make_items
+        self._it = None
+
+    def begin(self, transforms):
+        it = iter(self._make_items())
+        for fn in transforms:
+            it = fn(it)
+        self._it = it
+        return "ok"
 
     def par_iter_next(self, batch: int = 1):
+        if self._it is None:
+            self.begin([])
         out = []
         try:
             for _ in range(batch):
@@ -30,10 +42,6 @@ class _ShardActor:
             if not out:
                 raise StopIteration_()
         return out
-
-    def apply_transform(self, fn):
-        self._it = fn(self._it)
-        return "ok"
 
     def ping(self):
         return "ok"
@@ -63,9 +71,10 @@ def from_range(n: int, num_shards: int = 2) -> "ParallelIterator":
 
 
 class ParallelIterator:
-    def __init__(self, actors: List, name: str):
+    def __init__(self, actors: List, name: str, transforms=()):
         self.actors = actors
         self.name = name
+        self._transforms = tuple(transforms)
 
     def __repr__(self):
         return f"ParallelIterator[{self.name}]"
@@ -73,10 +82,10 @@ class ParallelIterator:
     def num_shards(self) -> int:
         return len(self.actors)
 
-    # -- transforms (applied remotely, lazily) ---------------------------
+    # -- transforms (recorded locally, applied at gather time) -----------
     def _transformed(self, fn, label: str) -> "ParallelIterator":
-        ray_tpu.get([a.apply_transform.remote(fn) for a in self.actors])
-        return ParallelIterator(self.actors, f"{self.name}.{label}")
+        return ParallelIterator(self.actors, f"{self.name}.{label}",
+                                self._transforms + (fn,))
 
     def for_each(self, fn: Callable) -> "ParallelIterator":
         def transform(it, _fn=fn):
@@ -107,16 +116,31 @@ class ParallelIterator:
         return self._transformed(transform, "flatten()")
 
     # -- consumption -----------------------------------------------------
+    def _begin(self):
+        ray_tpu.get([a.begin.remote(list(self._transforms))
+                     for a in self.actors])
+
+    @staticmethod
+    def _shard_done(e: Exception) -> bool:
+        # Only the exhaustion sentinel ends a shard; user exceptions
+        # propagate (silently dropping the shard would lose data).
+        if "StopIteration_" in type(e).__name__:
+            return True
+        return "StopIteration_" in str(e)
+
     def gather_sync(self) -> "LocalIterator":
         """Round-robin over shards, one item at a time (deterministic)."""
         def gen():
+            self._begin()
             live = collections.deque(self.actors)
             while live:
                 a = live.popleft()
                 try:
                     items = ray_tpu.get(a.par_iter_next.remote(1))
-                except Exception:
-                    continue  # shard exhausted
+                except Exception as e:
+                    if self._shard_done(e):
+                        continue
+                    raise
                 yield from items
                 live.append(a)
         return LocalIterator(gen, name=f"{self.name}.gather_sync()")
@@ -124,6 +148,7 @@ class ParallelIterator:
     def gather_async(self, batch_ms: int = 0) -> "LocalIterator":
         """Items in completion order across shards."""
         def gen():
+            self._begin()
             in_flight = {a.par_iter_next.remote(1): a
                          for a in self.actors}
             while in_flight:
@@ -132,8 +157,10 @@ class ParallelIterator:
                 actor = in_flight.pop(ref)
                 try:
                     items = ray_tpu.get(ref)
-                except Exception:
-                    continue
+                except Exception as e:
+                    if self._shard_done(e):
+                        continue
+                    raise
                 in_flight[actor.par_iter_next.remote(1)] = actor
                 yield from items
         return LocalIterator(gen, name=f"{self.name}.gather_async()")

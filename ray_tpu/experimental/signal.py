@@ -72,10 +72,12 @@ def receive(sources: List, timeout: float = None
                                     timeout=30)
             log = cloudpickle.loads(reply["value"]) \
                 if reply["value"] else []
-            cursor = rt._signal_cursors.get((id(source), key), 0)
+            # Cursor keyed by the source's KV key (stable across handle
+            # objects), not id(source) (recycled ids skip/replay signals).
+            cursor = rt._signal_cursors.get(key, 0)
             for sig in log[cursor:]:
                 out.append((source, sig))
-            rt._signal_cursors[(id(source), key)] = len(log)
+            rt._signal_cursors[key] = len(log)
         if out or (deadline is not None
                    and time.monotonic() >= deadline):
             return out

@@ -1,0 +1,3 @@
+from .qmix import DEFAULT_CONFIG, QMIXPolicy, QMIXTrainer
+
+__all__ = ["DEFAULT_CONFIG", "QMIXPolicy", "QMIXTrainer"]

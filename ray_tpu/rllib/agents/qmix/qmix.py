@@ -1,0 +1,277 @@
+"""QMIX: monotonic value factorization for cooperative multi-agent RL.
+
+Parity: `rllib/agents/qmix/qmix.py` + `qmix_policy.py` (+ the grouping
+trick of `rllib/env/group_agents_wrapper.py`): each agent has a shared
+utility network Q_i(o_i, a_i); a monotonic mixing network (hypernetworks
+conditioned on the global state emit non-negative weights) combines the
+chosen utilities into Q_tot, trained by TD against a target mixer.
+
+TPU re-architecture: the whole update — per-agent utilities, mixing,
+target mixing over the argmax actions, TD loss, optimizer, and the
+periodic polyak-free hard target copy trigger — is ONE donated-buffer
+XLA program over [B, n_agents, ...] tensors; grouping is handled by the
+GroupedMultiAgentEnv wrapper which exposes the joint env through the
+standard Env interface (obs [n_agents, obs_dim], action [n_agents]).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from ....parallel import mesh as mesh_lib
+from ... import sample_batch as sb
+from ...policy.policy import Policy
+from ...utils.config import deep_merge
+from ..dqn.dqn import make_sync_replay_optimizer, setup_exploration, \
+    update_target_and_epsilon
+from ..trainer import with_common_config
+from ..trainer_template import build_trainer
+
+DEFAULT_CONFIG = with_common_config({
+    "mixing_embed_dim": 32,
+    "agent_hiddens": [64],
+    "double_q": True,
+    "gamma": 0.99,
+    "lr": 5e-4,
+    "grad_clip": 10.0,
+    "exploration_initial_eps": 1.0,
+    "exploration_final_eps": 0.02,
+    "exploration_timesteps": 10000,
+    "buffer_size": 5000,
+    "prioritized_replay": False,
+    "learning_starts": 200,
+    "train_batch_size": 32,
+    "rollout_fragment_length": 4,
+    "target_network_update_freq": 200,
+    "timesteps_per_iteration": 500,
+    "use_gae": False,
+})
+
+
+class _AgentQNet(nn.Module):
+    """Shared per-agent utility network: obs [B, n, d] -> Q [B, n, A]."""
+
+    num_actions: int
+    hiddens: tuple = (64,)
+
+    @nn.compact
+    def __call__(self, obs):
+        h = obs.astype(jnp.float32)
+        for i, size in enumerate(self.hiddens):
+            h = nn.relu(nn.Dense(size, name=f"fc_{i}")(h))
+        return nn.Dense(self.num_actions, name="q")(h)
+
+
+class _Mixer(nn.Module):
+    """Monotonic mixer: hypernetworks emit |weights| from the state."""
+
+    embed_dim: int = 32
+
+    @nn.compact
+    def __call__(self, agent_qs, state):
+        # agent_qs [B, n], state [B, s]
+        B, n = agent_qs.shape
+        w1 = jnp.abs(nn.Dense(n * self.embed_dim, name="hyper_w1")(state))
+        w1 = w1.reshape(B, n, self.embed_dim)
+        b1 = nn.Dense(self.embed_dim, name="hyper_b1")(state)
+        hidden = nn.elu(jnp.einsum("bn,bne->be", agent_qs, w1) + b1)
+        w2 = jnp.abs(nn.Dense(self.embed_dim, name="hyper_w2")(state))
+        b2 = nn.Dense(1, name="hyper_b2_out")(
+            nn.relu(nn.Dense(self.embed_dim, name="hyper_b2_in")(state)))
+        return jnp.sum(hidden * w2, axis=-1) + b2[:, 0]
+
+
+class QMIXPolicy(Policy):
+    def __init__(self, observation_space, action_space, config):
+        cfg = deep_merge(deep_merge({}, DEFAULT_CONFIG), config)
+        super().__init__(observation_space, action_space, cfg)
+        # Grouped spaces: obs [n_agents, obs_dim]; Discrete joint action
+        # per agent.
+        self.n_agents, self.obs_dim = observation_space.shape
+        self.num_actions = action_space.n
+        self.state_dim = self.n_agents * self.obs_dim
+
+        self.agent_net = _AgentQNet(
+            num_actions=self.num_actions,
+            hiddens=tuple(cfg["agent_hiddens"]))
+        self.mixer = _Mixer(embed_dim=cfg["mixing_embed_dim"])
+
+        seed = cfg.get("seed") or 0
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng_i = 0
+        self._np_rng = np.random.RandomState(seed)
+        self.epsilon = cfg["exploration_initial_eps"]
+
+        dummy_obs = np.zeros((1, self.n_agents, self.obs_dim), np.float32)
+        dummy_q = np.zeros((1, self.n_agents), np.float32)
+        dummy_state = np.zeros((1, self.state_dim), np.float32)
+        params = {
+            "agent": self.agent_net.init(self._next_rng(), dummy_obs),
+            "mixer": self.mixer.init(self._next_rng(), dummy_q,
+                                     dummy_state),
+        }
+        tx = optax.adam(cfg["lr"])
+        if cfg.get("grad_clip"):
+            tx = optax.chain(
+                optax.clip_by_global_norm(cfg["grad_clip"]), tx)
+        self.tx = tx
+        opt_state = tx.init(params)
+
+        self.mesh = cfg.get("_mesh") or mesh_lib.make_mesh(num_devices=1)
+        self._repl = mesh_lib.replicated(self.mesh)
+        self._bshard = mesh_lib.batch_sharded(self.mesh)
+        self.params = mesh_lib.put_replicated(params, self.mesh)
+        self.opt_state = mesh_lib.put_replicated(opt_state, self.mesh)
+        self._copy = jax.jit(lambda p: jax.tree.map(jnp.copy, p))
+        self.target_params = self._copy(self.params)
+
+        self._lock = threading.Lock()
+        self.global_timestep = 0
+        self._build_fns(cfg)
+
+    def _next_rng(self):
+        self._rng_i += 1
+        return jax.random.fold_in(self._rng, self._rng_i)
+
+    def _build_fns(self, cfg):
+        gamma = cfg["gamma"]
+        double_q = cfg["double_q"]
+
+        def q_tot(params, obs, actions):
+            # obs [B, n, d], actions [B, n] -> scalar Q_tot [B]
+            q = self.agent_net.apply(params["agent"], obs)
+            chosen = jnp.take_along_axis(
+                q, actions[..., None].astype(jnp.int32), axis=-1)[..., 0]
+            state = obs.reshape(obs.shape[0], -1)
+            return self.mixer.apply(params["mixer"], chosen, state)
+
+        def target_max_qtot(params, target_params, next_obs):
+            tq = self.agent_net.apply(target_params["agent"], next_obs)
+            if double_q:
+                oq = self.agent_net.apply(params["agent"], next_obs)
+                best = jnp.argmax(oq, axis=-1)
+            else:
+                best = jnp.argmax(tq, axis=-1)
+            chosen = jnp.take_along_axis(
+                tq, best[..., None], axis=-1)[..., 0]
+            state = next_obs.reshape(next_obs.shape[0], -1)
+            return self.mixer.apply(target_params["mixer"], chosen, state)
+
+        def loss_fn(params, target_params, batch):
+            qt = q_tot(params, batch[sb.OBS], batch[sb.ACTIONS])
+            tmax = target_max_qtot(params, target_params,
+                                   batch[sb.NEW_OBS])
+            target = batch[sb.REWARDS] + gamma * tmax \
+                * (1.0 - batch[sb.DONES])
+            td = qt - jax.lax.stop_gradient(target)
+            return jnp.mean(td ** 2), (td, jnp.mean(qt))
+
+        def update(params, target_params, opt_state, batch):
+            (loss, (td, mean_q)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            upd, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, upd)
+            stats = {"loss": loss, "mean_qtot": mean_q, "td_error": td}
+            return params, opt_state, stats
+
+        self._update = jax.jit(
+            update, donate_argnums=(0, 2),
+            in_shardings=(self._repl, self._repl, self._repl,
+                          self._bshard),
+            out_shardings=(self._repl, self._repl, self._repl))
+
+        self._q_fn = jax.jit(
+            lambda params, obs: self.agent_net.apply(params["agent"], obs))
+
+    # -- rollouts --------------------------------------------------------
+    def set_epsilon(self, eps: float):
+        self.epsilon = float(eps)
+
+    def compute_actions(self, obs_batch, state_batches=None, explore=True,
+                        prev_action_batch=None, prev_reward_batch=None):
+        obs = jnp.asarray(np.asarray(obs_batch, np.float32))
+        with self._lock:
+            q = np.asarray(self._q_fn(self.params, obs))  # [B, n, A]
+        actions = q.argmax(-1)
+        if explore:
+            B, n = actions.shape
+            rand = self._np_rng.rand(B, n) < self.epsilon
+            actions = np.where(
+                rand, self._np_rng.randint(0, self.num_actions, (B, n)),
+                actions)
+        self.global_timestep += len(actions)
+        return actions.astype(np.int64), [], {}
+
+    # -- learning --------------------------------------------------------
+    def _device_batch(self, batch):
+        out = {}
+        for k in (sb.OBS, sb.NEW_OBS, sb.ACTIONS, sb.REWARDS, sb.DONES):
+            v = np.asarray(batch[k])
+            if v.dtype in (np.float64, np.bool_):
+                v = v.astype(np.float32)
+            out[k] = jax.device_put(v, self._bshard)
+        return out
+
+    def learn_with_td(self, batch):
+        """Update + |TD| feedback (prioritized replay's interface)."""
+        dev = self._device_batch(batch)
+        with self._lock:
+            self.params, self.opt_state, stats = self._update(
+                self.params, self.target_params, self.opt_state, dev)
+        stats = dict(stats)
+        td = np.asarray(stats.pop("td_error"))
+        return {k: float(v) for k, v in stats.items()}, np.abs(td)
+
+    def learn_on_batch(self, batch) -> Dict:
+        stats, _ = self.learn_with_td(batch)
+        return stats
+
+    def update_target(self):
+        with self._lock:
+            self.target_params = self._copy(self.params)
+
+    # -- state -----------------------------------------------------------
+    def get_weights(self):
+        with self._lock:
+            return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        with self._lock:
+            self.params = mesh_lib.put_replicated(
+                jax.tree.map(jnp.asarray, weights), self.mesh)
+
+    def get_state(self):
+        with self._lock:
+            return {
+                "weights": jax.tree.map(np.asarray, self.params),
+                "target": jax.tree.map(np.asarray, self.target_params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "global_timestep": self.global_timestep,
+                "epsilon": self.epsilon,
+            }
+
+    def set_state(self, state):
+        self.set_weights(state["weights"])
+        with self._lock:
+            self.target_params = mesh_lib.put_replicated(
+                jax.tree.map(jnp.asarray, state["target"]), self.mesh)
+            self.opt_state = mesh_lib.put_replicated(
+                jax.tree.map(jnp.asarray, state["opt_state"]), self.mesh)
+        self.global_timestep = state.get("global_timestep", 0)
+        self.epsilon = state.get("epsilon", self.epsilon)
+
+
+QMIXTrainer = build_trainer(
+    name="QMIX",
+    default_policy=QMIXPolicy,
+    default_config=DEFAULT_CONFIG,
+    make_policy_optimizer=make_sync_replay_optimizer,
+    after_init=setup_exploration,
+    after_optimizer_step=update_target_and_epsilon)

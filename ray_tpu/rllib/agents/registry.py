@@ -81,6 +81,11 @@ def _marwil():
     return MARWILTrainer
 
 
+def _qmix():
+    from .qmix import QMIXTrainer
+    return QMIXTrainer
+
+
 ALGORITHMS = {
     "PG": _pg,
     "PPO": _ppo,
@@ -98,6 +103,7 @@ ALGORITHMS = {
     "ES": _es,
     "ARS": _ars,
     "MARWIL": _marwil,
+    "QMIX": _qmix,
 }
 
 

@@ -1,0 +1,96 @@
+"""Agent grouping: a cooperative MultiAgentEnv as one joint-action Env.
+
+Parity: `rllib/env/group_agents_wrapper.py` + the `with_agent_groups`
+trick QMIX requires — the group's observations stack into one
+[n_agents, obs_dim] tensor, the policy emits one action per agent, and
+rewards sum across the team. `TwoStepGame` is the QMIX paper's
+coordination problem (reference: `rllib/examples/twostep_game.py`):
+independent greedy learners settle for payoff 7, a monotonic mixer can
+credit the coordinated branch worth 8.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .multi_agent_env import MultiAgentEnv
+from .spaces import Box, Discrete
+
+
+class GroupedMultiAgentEnv:
+    """All agents grouped into one: obs [n, d], action vector [n]."""
+
+    def __init__(self, env: MultiAgentEnv, n_agents: int):
+        self.env = env
+        self.n_agents = n_agents
+        obs_space = env.observation_space
+        d = int(np.prod(obs_space.shape))
+        self.observation_space = Box(
+            -np.inf, np.inf, shape=(n_agents, d))
+        self.action_space = env.action_space  # per-agent Discrete
+        self._ids = None
+
+    def _stack(self, obs_dict):
+        if self._ids is None:
+            self._ids = sorted(obs_dict)
+        return np.stack([np.asarray(obs_dict[i], np.float32).ravel()
+                         for i in self._ids])
+
+    def reset(self):
+        obs = self.env.reset()
+        self._ids = sorted(obs)
+        return self._stack(obs)
+
+    def step(self, action_vec):
+        actions = {aid: int(action_vec[i])
+                   for i, aid in enumerate(self._ids)}
+        obs, rew, done, info = self.env.step(actions)
+        team_reward = float(sum(rew.values()))
+        return (self._stack(obs), team_reward,
+                bool(done.get("__all__")), {})
+
+    def close(self):
+        self.env.close()
+
+    def seed(self, seed=None):
+        self.env.seed(seed)
+
+
+class TwoStepGame(MultiAgentEnv):
+    """QMIX paper's two-step coordination game, 2 agents x 2 actions.
+
+    Step 1: agent 0's action picks the branch. Step 2A pays 7 for any
+    joint action; step 2B pays [[0, 1], [1, 8]] — the optimum (8) needs
+    BOTH agents to pick action 1 after agent 0 chose the risky branch.
+    """
+
+    PAYOFF_2B = np.array([[0.0, 1.0], [1.0, 8.0]])
+
+    def __init__(self):
+        self.observation_space = Box(0.0, 1.0, shape=(3,))
+        self.action_space = Discrete(2)
+        self._state = 0
+
+    def _obs(self):
+        one_hot = np.zeros(3, np.float32)
+        one_hot[self._state] = 1.0
+        return {0: one_hot.copy(), 1: one_hot.copy()}
+
+    def reset(self):
+        self._state = 0
+        return self._obs()
+
+    def step(self, actions):
+        if self._state == 0:
+            self._state = 1 if actions[0] == 0 else 2
+            obs = self._obs()
+            return obs, {0: 0.0, 1: 0.0}, \
+                {0: False, 1: False, "__all__": False}, {}
+        if self._state == 1:  # branch 2A: safe payoff
+            reward = 7.0
+        else:                 # branch 2B: coordination payoff
+            reward = float(self.PAYOFF_2B[actions[0], actions[1]])
+        obs = self._obs()
+        half = reward / 2.0
+        return obs, {0: half, 1: half}, \
+            {0: True, 1: True, "__all__": True}, {}

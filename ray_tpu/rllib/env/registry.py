@@ -54,3 +54,11 @@ def _multiagent_cartpole(cfg):
 
 
 register_env("MultiAgentCartPole-v0", _multiagent_cartpole)
+
+
+def _two_step_game_grouped(cfg):
+    from .group_agents_wrapper import GroupedMultiAgentEnv, TwoStepGame
+    return GroupedMultiAgentEnv(TwoStepGame(), n_agents=2)
+
+
+register_env("GroupedTwoStepGame-v0", _two_step_game_grouped)

@@ -154,6 +154,11 @@ class RolloutWorker:
         self.env = None
         mapping = ma_cfg.get("policy_mapping_fn") \
             or (lambda aid: next(iter(self.policy_map)))
+        if isinstance(mapping, str):
+            # yaml configs carry the mapping fn as source text
+            # (reference yamls name registered functions; a lambda
+            # string is the picklable equivalent here).
+            mapping = eval(mapping)  # noqa: S307 — user-authored config
 
         def postprocess(pid, chunk, bootstrap_obs):
             # Read GAE knobs from the policy's own merged config so

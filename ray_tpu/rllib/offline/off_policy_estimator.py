@@ -29,9 +29,6 @@ class OffPolicyEstimator:
     def __init__(self, policy, gamma: float = 0.99):
         self.policy = policy
         self.gamma = gamma
-        # running normalization state for WIS
-        self._rho_sum = 0.0
-        self._rho_count = 0
 
     def _action_logp(self, batch: SampleBatch) -> np.ndarray:
         """Target policy's log-prob of the logged actions."""
@@ -79,21 +76,22 @@ class WeightedImportanceSamplingEstimator(OffPolicyEstimator):
 
     def __init__(self, policy, gamma: float = 0.99):
         super().__init__(policy, gamma)
-        self._pt_sums: list = []   # running sum of p[t] per step index
-        self._pt_count = 0
+        self._pt_sums: list = []    # running sum of p[t] per step index
+        self._pt_counts: list = []  # episodes long enough to reach t
 
     def estimate(self, episode: SampleBatch) -> OffPolicyEstimate:
         rewards, rho = self._rewards_and_rho(episode)
         p = np.cumprod(rho)
         while len(self._pt_sums) < len(p):
             self._pt_sums.append(0.0)
+            self._pt_counts.append(0)
         for t in range(len(p)):
             self._pt_sums[t] += float(p[t])
-        self._pt_count += 1
+            self._pt_counts[t] += 1
         v_old = 0.0
         v_new = 0.0
         for t in range(len(rewards)):
-            w_bar_t = self._pt_sums[t] / self._pt_count
+            w_bar_t = self._pt_sums[t] / self._pt_counts[t]
             v_old += rewards[t] * self.gamma ** t
             v_new += (p[t] / max(1e-8, w_bar_t)) * rewards[t] \
                 * self.gamma ** t

@@ -16,6 +16,23 @@ class TestParallelIterator:
                         .gather_sync().take(10))
         assert result == [0, 4, 8, 12, 16]
 
+    def test_branching_iterators_independent(self, ray_start):
+        """Branches off one iterator must not see each other's
+        transforms (reference ParallelIterator semantics)."""
+        from ray_tpu.experimental import from_items
+        base = from_items([1, 2, 3, 4], num_shards=2)
+        evens = base.filter(lambda x: x % 2 == 0)
+        odds = base.filter(lambda x: x % 2 == 1)
+        assert sorted(evens.gather_sync().take(4)) == [2, 4]
+        assert sorted(odds.gather_sync().take(4)) == [1, 3]
+
+    def test_shard_errors_propagate(self, ray_start):
+        from ray_tpu.experimental import from_items
+        it = from_items([1, 0, 2], num_shards=1).for_each(
+            lambda x: 1 // x)
+        with pytest.raises(Exception):
+            it.gather_sync().take(3)
+
     def test_batch_and_async(self, ray_start):
         from ray_tpu.experimental import from_range
         it = from_range(8, num_shards=2).batch(2)
