@@ -12,6 +12,7 @@ from .logger import (CSVLogger, JsonLogger, Logger, TBXLogger,  # noqa: F401
 from .registry import get_trainable_cls, register_trainable  # noqa: F401
 from .sample import (choice, function, grid_search, loguniform,  # noqa: F401
                      randint, randn, sample_from, uniform)
+from .syncer import DurableTrainable, Syncer  # noqa: F401
 from .trainable import Trainable  # noqa: F401
 from .trial import Trial  # noqa: F401
 from .trial_runner import TrialRunner  # noqa: F401

@@ -1,0 +1,113 @@
+"""Checkpoint durability: sync trial checkpoints to a durable location.
+
+Parity: `tune/syncer.py` + `sync_client.py` + `DurableTrainable` — the
+reference rsyncs logdirs to cloud/remote storage so trials survive node
+loss. Here `Syncer` mirrors checkpoint directories into an `upload_dir`
+(any mounted path — NFS, fuse-mounted object storage, or a local durable
+disk) and restores from it on demand; `DurableTrainable` wires the sync
+into every save/restore so a trial rescheduled onto another node finds
+its state. Durable names are namespaced per trainable instance so many
+trials can share one upload_dir, and uploads land via a temp-dir +
+rename so a crash mid-copy never destroys the previous durable copy.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Optional
+
+from .trainable import Trainable
+
+
+class Syncer:
+    def __init__(self, upload_dir: str):
+        self.upload_dir = upload_dir
+        os.makedirs(upload_dir, exist_ok=True)
+
+    def sync_up(self, local_dir: str, name: str) -> str:
+        """Mirror a local checkpoint dir to `upload_dir/name`. The copy
+        lands under a temp name and replaces the old version only at
+        rename time — a crash mid-copy leaves the previous durable copy
+        intact."""
+        dest = os.path.join(self.upload_dir, name)
+        tmp = f"{dest}.uploading-{os.getpid()}"
+        if os.path.exists(tmp):
+            shutil.rmtree(tmp)
+        shutil.copytree(local_dir, tmp)
+        if os.path.exists(dest):
+            shutil.rmtree(dest)
+        os.rename(tmp, dest)
+        return dest
+
+    def sync_down(self, name: str, local_dir: str) -> str:
+        """Materialize a durable checkpoint dir locally."""
+        src = os.path.join(self.upload_dir, name)
+        if os.path.exists(local_dir):
+            shutil.rmtree(local_dir)
+        shutil.copytree(src, local_dir)
+        return local_dir
+
+    def delete(self, name: str):
+        shutil.rmtree(os.path.join(self.upload_dir, name),
+                      ignore_errors=True)
+
+
+class DurableTrainable(Trainable):
+    """A Trainable whose checkpoints live in `upload_dir` (parity:
+    `tune/durable_trainable.py`). Subclasses implement _train/_save/
+    _restore exactly as for Trainable. Disk checkpoints return DURABLE
+    paths (namespaced `<trial>-checkpoint_N`), and the local copy is
+    removed after upload so worker disks don't accumulate; in-memory
+    blobs (`save_to_object`, used for pause/PBT exploits) skip the sync
+    entirely — they are owned by the driver."""
+
+    def __init__(self, config=None, logger_creator=None):
+        config = dict(config or {})
+        self._upload_dir = config.pop("upload_dir", None)
+        if not self._upload_dir:
+            raise ValueError(
+                "DurableTrainable requires config['upload_dir']")
+        self._syncer = Syncer(self._upload_dir)
+        self._skip_sync = False
+        super().__init__(config, logger_creator)
+
+    def _namespace(self) -> str:
+        # Unique per trainable instance (trial): many trials share one
+        # upload_dir without clobbering each other's checkpoint_N dirs.
+        return self.config.get("trial_id") \
+            or os.path.basename(self.logdir.rstrip("/"))
+
+    def save(self, checkpoint_dir: Optional[str] = None) -> str:
+        path = super().save(checkpoint_dir)
+        if self._skip_sync:
+            return path
+        local_dir = os.path.dirname(path)
+        name = f"{self._namespace()}-{os.path.basename(local_dir)}"
+        remote_dir = self._syncer.sync_up(local_dir, name)
+        rel = os.path.relpath(path, local_dir)
+        # Drop the local copy: the durable one is authoritative, and
+        # checkpoint eviction deletes by the returned (durable) path.
+        if os.path.realpath(local_dir).startswith(
+                os.path.realpath(self.logdir)):
+            shutil.rmtree(local_dir, ignore_errors=True)
+        return os.path.join(remote_dir, rel)
+
+    def save_to_object(self) -> bytes:
+        self._skip_sync = True
+        try:
+            return super().save_to_object()
+        finally:
+            self._skip_sync = False
+
+    def restore(self, checkpoint_path: str):
+        if os.path.exists(checkpoint_path + ".tune_metadata"):
+            return super().restore(checkpoint_path)
+        # Durable dir not reachable at its recorded path (e.g. relative
+        # mount differences): pull it down next to the logdir.
+        remote_dir = os.path.dirname(checkpoint_path)
+        local_dir = os.path.join(
+            self.logdir, os.path.basename(remote_dir))
+        self._syncer.sync_down(os.path.basename(remote_dir), local_dir)
+        return super().restore(os.path.join(
+            local_dir, os.path.basename(checkpoint_path)))
