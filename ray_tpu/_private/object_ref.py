@@ -57,6 +57,13 @@ class ObjectRef:
         return f"ObjectRef({self.id.hex()[:16]})"
 
     def __reduce__(self):
+        # Pickling a ref we own means a peer may be about to borrow it;
+        # tell the tracker so eviction waits for the borrow to register.
+        if _tracker is not None:
+            try:
+                _tracker.note_export(self.id, self.owner_addr)
+            except Exception:
+                pass
         return (ObjectRef, (self.id, self.owner_addr, self.size_hint))
 
     # Keep users from iterating a ref thinking it's the value.

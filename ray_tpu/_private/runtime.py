@@ -95,6 +95,14 @@ class _RefTracker:
         with self._lock:
             return self._counts.get(oid, 0)
 
+    def note_export(self, oid: ObjectID, owner_addr: str):
+        """Called when a ref we OWN is pickled for a peer: a borrower's
+        add_borrow may now be in flight, so the owner must not treat the
+        object as unreferenced until the notification has had time to
+        land (`Runtime._make_room` grace window)."""
+        if owner_addr == self._rt.addr:
+            self._rt._exported_at[oid] = time.monotonic()
+
     def _notify_loop(self):
         while True:
             owner_addr, kind, oid = self._notify_q.get()
@@ -188,9 +196,18 @@ class Runtime:
             except OSError:
                 self._store_capacity = 2 << 30
         # Cached node-wide usage (a filesystem glob): refreshed when the
-        # cheap per-process accounting can't rule out an overrun.
+        # cheap per-process accounting can't rule out an overrun, and
+        # periodically (by bytes written) so concurrent puts from OTHER
+        # processes are observed before large overshoots.
         self._store_used_cache = 0
         self._store_used_dirty = True
+        self._bytes_since_refresh = 0
+        # Owned objects whose refs were pickled for a peer: a borrower's
+        # add_borrow may be in flight, so eviction waits out a grace
+        # window (oid -> export monotonic time).
+        self._exported_at: Dict[ObjectID, float] = {}
+        self._eviction_grace = float(
+            os.environ.get("RAY_TPU_EVICTION_GRACE_S", "10"))
         self.ref_tracker = _RefTracker(self)
         # In-flight inbound chunked transfers: oid -> {total, chunks}.
         self._chunk_buf: Dict[ObjectID, dict] = {}
@@ -273,19 +290,25 @@ class Runtime:
         from ..exceptions import ObjectStoreFullError
         with self._owned_lock:
             own = sum(self._owned.values())
+            self._bytes_since_refresh += incoming
             # Fast path: even if every other process held the rest of
-            # the capacity when we last looked, we still fit.
+            # the capacity when we last looked, we still fit. The cache
+            # also expires by write volume so cross-process growth is
+            # observed before large overshoots.
             if self._store_used_dirty or \
-                    self._store_used_cache + own + incoming \
+                    self._bytes_since_refresh > self._store_capacity // 16 \
+                    or self._store_used_cache + own + incoming \
                     > self._store_capacity:
                 self._store_used_cache = self.shm.used_bytes() - own
                 if self._store_used_cache < 0:
                     self._store_used_cache = 0
                 self._store_used_dirty = False
+                self._bytes_since_refresh = 0
             used = self._store_used_cache + own
             if used + incoming <= self._store_capacity:
                 return
             victims = []
+            now = time.monotonic()
             for oid in list(self._owned):
                 if used + incoming <= self._store_capacity:
                     break
@@ -293,7 +316,15 @@ class Runtime:
                     continue
                 if self._borrows.get(oid, 0) > 0:
                     continue
+                # Exported refs may have an add_borrow in flight from a
+                # peer that just deserialized them: not evictable until
+                # the grace window has passed.
+                exported = self._exported_at.get(oid)
+                if exported is not None and \
+                        now - exported < self._eviction_grace:
+                    continue
                 victims.append(oid)
+                self._exported_at.pop(oid, None)
                 used -= self._owned.pop(oid)
             over = used + incoming > self._store_capacity
         for oid in victims:
@@ -302,8 +333,10 @@ class Runtime:
         if over:
             raise ObjectStoreFullError(
                 f"object store over capacity "
-                f"({used + incoming} > {self._store_capacity} bytes) "
-                f"and every object this process owns is still referenced")
+                f"({used + incoming} > {self._store_capacity} bytes); "
+                f"every object this process owns is still referenced, "
+                f"borrowed, or inside the export grace window "
+                f"(RAY_TPU_EVICTION_GRACE_S={self._eviction_grace:g}s)")
 
     def get(self, refs, timeout: Optional[float] = None):
         single = isinstance(refs, ObjectRef)
@@ -439,6 +472,7 @@ class Runtime:
             self.shm.delete(r.id)
             with self._owned_lock:
                 self._owned.pop(r.id, None)
+                self._exported_at.pop(r.id, None)
 
     # ==================================================================
     # task submission

@@ -155,10 +155,11 @@ class RolloutWorker:
         mapping = ma_cfg.get("policy_mapping_fn") \
             or (lambda aid: next(iter(self.policy_map)))
         if isinstance(mapping, str):
-            # yaml configs carry the mapping fn as source text
-            # (reference yamls name registered functions; a lambda
-            # string is the picklable equivalent here).
-            mapping = eval(mapping)  # noqa: S307 — user-authored config
+            # yaml configs name a registered mapping fn (parity with the
+            # reference's registry lookups); config text is never eval'd.
+            from ..utils.registry import resolve_policy_mapping_fn
+            mapping = resolve_policy_mapping_fn(
+                mapping, sorted(self.policy_map))
 
         def postprocess(pid, chunk, bootstrap_obs):
             # Read GAE knobs from the policy's own merged config so

@@ -24,6 +24,11 @@ class WorkerSet:
         self._env_creator = env_creator
         self._policy_cls = policy_cls
         self._config = config
+        # Resolve a string policy_mapping_fn against the DRIVER's registry
+        # here, before any worker ships: remote actors run in fresh
+        # processes that only have the built-in registrations, so the
+        # resolved closure (cloudpickle-able) travels in the config.
+        self._resolve_mapping_fn(config)
         policy_config = dict(config.get("policy_config") or config)
         local_policy_config = dict(policy_config)
         if local_mesh is not None:
@@ -46,6 +51,20 @@ class WorkerSet:
                 self.remote_workers.append(self._make_remote_worker(i + 1))
             # Block until all workers are constructed.
             ray_tpu.get([w.ping.remote() for w in self.remote_workers])
+
+    @staticmethod
+    def _resolve_mapping_fn(config: dict) -> None:
+        for holder in (config, config.get("policy_config") or {}):
+            ma = holder.get("multiagent") or {}
+            mfn = ma.get("policy_mapping_fn")
+            if isinstance(mfn, str):
+                from ..utils.registry import resolve_policy_mapping_fn
+                pids = sorted(ma.get("policies")
+                              or {"default_policy": None})
+                ma = dict(ma)
+                ma["policy_mapping_fn"] = resolve_policy_mapping_fn(
+                    mfn, pids)
+                holder["multiagent"] = ma
 
     def _make_remote_worker(self, index: int):
         cfg = self._config

@@ -63,8 +63,14 @@ class ImportanceSamplingEstimator(OffPolicyEstimator):
         return OffPolicyEstimate("is", {
             "V_prev": float(v_old),
             "V_step_IS": float(v_new),
-            "V_gain_est": float(v_new / max(1e-8, v_old))
-            if v_old else 0.0,
+            # Guard only near-zero magnitude: negative returns (e.g.
+            # Pendulum) must divide by the true v_old, not a clamp.
+            # NB: with v_old < 0 the ratio reads inversely (gain < 1
+            # means the target policy improved) — inherent to a ratio
+            # gain metric; callers compare V_step_* to V_prev directly
+            # when returns can be negative.
+            "V_gain_est": float(v_new / v_old)
+            if abs(v_old) > 1e-8 else 0.0,
         })
 
 
@@ -98,6 +104,6 @@ class WeightedImportanceSamplingEstimator(OffPolicyEstimator):
         return OffPolicyEstimate("wis", {
             "V_prev": float(v_old),
             "V_step_WIS": float(v_new),
-            "V_gain_est": float(v_new / max(1e-8, v_old))
-            if v_old else 0.0,
+            "V_gain_est": float(v_new / v_old)
+            if abs(v_old) > 1e-8 else 0.0,
         })

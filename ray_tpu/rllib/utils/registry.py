@@ -1,0 +1,60 @@
+"""Named registries for config-referenced callables.
+
+Parity: the reference resolves callables named in config files through
+registries (`python/ray/tune/registry.py` `register_trainable`,
+`rllib/agents/registry.py`, `tune/registry.py` env registry) instead of
+executing config text. String `policy_mapping_fn` values in YAML configs
+are looked up here by name — raw source text is rejected, so a config
+file can never become an arbitrary-code-execution vector.
+"""
+
+from typing import Callable, Dict, List
+import re
+import zlib
+
+# name -> factory(policy_ids: List[str]) -> Callable[[agent_id], policy_id]
+_MAPPING_FN_FACTORIES: Dict[str, Callable] = {}
+
+
+def register_policy_mapping_fn(name: str, factory: Callable) -> None:
+    """Register a policy-mapping-fn factory under `name`.
+
+    `factory(policy_ids)` receives the sorted policy ids configured for
+    the worker and returns the actual `agent_id -> policy_id` mapping.
+    Configs reference it as `multiagent.policy_mapping_fn: "<name>"`.
+    """
+    _MAPPING_FN_FACTORIES[name] = factory
+
+
+def resolve_policy_mapping_fn(name: str, policy_ids: List[str]) -> Callable:
+    try:
+        factory = _MAPPING_FN_FACTORIES[name]
+    except KeyError:
+        raise ValueError(
+            f"Unknown policy_mapping_fn {name!r}. String mapping fns must "
+            f"name a function registered via ray_tpu.rllib.utils.registry."
+            f"register_policy_mapping_fn (registered: "
+            f"{sorted(_MAPPING_FN_FACTORIES)}). Raw lambda source in "
+            f"config files is not executed.")
+    return factory(list(policy_ids))
+
+
+def _round_robin(policy_ids):
+    def mapping(agent_id):
+        # Numeric ids (python or numpy ints, digit strings) and the
+        # common '<name>_<N>' scheme round-robin by their index; only
+        # truly opaque ids fall back to a deterministic hash (crc32,
+        # not hash(): stable across processes).
+        try:
+            idx = int(agent_id)
+        except (TypeError, ValueError):
+            m = re.search(r"(\d+)$", str(agent_id))
+            idx = int(m.group(1)) if m \
+                else zlib.crc32(str(agent_id).encode())
+        return policy_ids[idx % len(policy_ids)]
+    return mapping
+
+
+register_policy_mapping_fn("round_robin", _round_robin)
+register_policy_mapping_fn(
+    "first_policy", lambda pids: (lambda agent_id: pids[0]))

@@ -13,6 +13,7 @@ rename so a crash mid-copy never destroys the previous durable copy.
 
 from __future__ import annotations
 
+import glob
 import os
 import shutil
 from typing import Optional
@@ -32,25 +33,47 @@ class Syncer:
         intact."""
         dest = os.path.join(self.upload_dir, name)
         tmp = f"{dest}.uploading-{os.getpid()}"
+        old = f"{dest}.old"
         if os.path.exists(tmp):
             shutil.rmtree(tmp)
+        # A crash between the two swap renames below leaves only `.old`;
+        # promote it back first so a sync_down-discoverable copy exists
+        # at every point of this retry too.
+        if not os.path.exists(dest) and os.path.exists(old):
+            os.rename(old, dest)
         shutil.copytree(local_dir, tmp)
+        # Swap via rename-aside so no window exists where BOTH the old
+        # and new durable copies are gone: dest -> dest.old, tmp -> dest,
+        # then drop the aside copy. sync_down falls back to `.old` if a
+        # crash lands between the two renames.
         if os.path.exists(dest):
-            shutil.rmtree(dest)
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.rename(dest, old)
         os.rename(tmp, dest)
+        shutil.rmtree(old, ignore_errors=True)
         return dest
 
     def sync_down(self, name: str, local_dir: str) -> str:
-        """Materialize a durable checkpoint dir locally."""
+        """Materialize a durable checkpoint dir locally. Falls back to
+        the rename-aside `.old` copy if a crash during sync_up left the
+        primary missing."""
         src = os.path.join(self.upload_dir, name)
+        if not os.path.exists(src) and os.path.exists(f"{src}.old"):
+            src = f"{src}.old"
         if os.path.exists(local_dir):
             shutil.rmtree(local_dir)
         shutil.copytree(src, local_dir)
         return local_dir
 
     def delete(self, name: str):
-        shutil.rmtree(os.path.join(self.upload_dir, name),
-                      ignore_errors=True)
+        dest = os.path.join(self.upload_dir, name)
+        shutil.rmtree(dest, ignore_errors=True)
+        # Also drop the crash-recovery aside and any stale temp copies so
+        # a deleted checkpoint can't be resurrected by sync_down.
+        shutil.rmtree(f"{dest}.old", ignore_errors=True)
+        for stale in glob.glob(glob.escape(dest) + ".uploading-*"):
+            shutil.rmtree(stale, ignore_errors=True)
 
 
 class DurableTrainable(Trainable):

@@ -114,3 +114,86 @@ class TestHorizonPlumbing:
             Box(low=-1, high=1, shape=(4,), dtype=np.float32), 2,
             {"use_lstm": True})
         assert hasattr(model, "initial_state")
+
+
+class TestAdvisoryFixes:
+    """Round-2 advisor findings (ADVICE.md r2)."""
+
+    def test_mapping_fn_registry_resolves_and_rejects(self):
+        from ray_tpu.rllib.utils.registry import (
+            register_policy_mapping_fn, resolve_policy_mapping_fn)
+        fn = resolve_policy_mapping_fn("round_robin", ["p0", "p1"])
+        assert fn(0) == "p0" and fn(1) == "p1" and fn(2) == "p0"
+        # String agent ids map deterministically.
+        assert fn("agent_7") in ("p0", "p1")
+        with pytest.raises(ValueError):
+            resolve_policy_mapping_fn("lambda aid: __import__('os')", ["p"])
+        register_policy_mapping_fn(
+            "all_to_first", lambda pids: (lambda aid: pids[0]))
+        fn2 = resolve_policy_mapping_fn("all_to_first", ["a", "b"])
+        assert fn2(99) == "a"
+
+    def test_ope_gain_sign_correct_for_negative_returns(self):
+        # V_gain_est must divide by the true v_old even when returns are
+        # negative (Pendulum-style), not clamp the denominator to 1e-8.
+        import types
+        from ray_tpu.rllib.offline.off_policy_estimator import (
+            ImportanceSamplingEstimator)
+        from ray_tpu.rllib.sample_batch import SampleBatch
+        est = ImportanceSamplingEstimator.__new__(
+            ImportanceSamplingEstimator)
+        est.gamma = 1.0
+        est._rewards_and_rho = types.MethodType(
+            lambda self, ep: (np.array([-1.0, -1.0]),
+                              np.array([1.0, 1.0])), est)
+        out = est.estimate(SampleBatch({"rewards": np.array([-1., -1.])}))
+        # rho == 1 everywhere -> gain must be exactly 1.0, not huge.
+        assert abs(out.metrics["V_gain_est"] - 1.0) < 1e-6
+
+    def test_syncer_sync_down_falls_back_to_old(self, tmp_path):
+        from ray_tpu.tune.syncer import Syncer
+        import os
+        up = tmp_path / "up"
+        local = tmp_path / "local"
+        local.mkdir()
+        (local / "ckpt").write_text("v1")
+        s = Syncer(str(up))
+        s.sync_up(str(local), "trial-1")
+        # Simulate a crash between the two sync_up renames: primary gone,
+        # aside copy present.
+        os.rename(up / "trial-1", up / "trial-1.old")
+        out = tmp_path / "restored"
+        s.sync_down("trial-1", str(out))
+        assert (out / "ckpt").read_text() == "v1"
+
+    def test_exported_refs_survive_eviction_grace(self, tmp_path):
+        """An owned object whose ref was pickled for a peer must not be
+        LRU-evicted inside the grace window even with zero local refs."""
+        import os
+        # 9 MiB: 5 x 2 MiB puts overshoot unconditionally, so the
+        # eviction path always runs (10 MiB would be a knife-edge).
+        os.environ["RAY_TPU_OBJECT_STORE_CAPACITY"] = str(9 * 1024 * 1024)
+        import pickle
+        import ray_tpu
+        ray_tpu.init(num_cpus=1)
+        try:
+            rt = ray_tpu._private.worker_state.get_runtime()
+            ref = ray_tpu.put(np.zeros(1 << 18))  # 2 MB
+            pickle.dumps(ref)   # simulates shipping the ref to a peer
+            oid = ref.id
+            del ref
+            # Pressure the store: without the grace window the exported
+            # object would be the LRU victim. With it, the store refuses
+            # to evict (raising full is the CORRECT outcome here).
+            from ray_tpu.exceptions import ObjectStoreFullError
+            held = []
+            try:
+                for _ in range(4):
+                    held.append(ray_tpu.put(np.zeros(1 << 18)))
+            except ObjectStoreFullError:
+                pass
+            assert oid in rt._exported_at
+            assert rt.shm.contains(oid)
+        finally:
+            ray_tpu.shutdown()
+            del os.environ["RAY_TPU_OBJECT_STORE_CAPACITY"]
