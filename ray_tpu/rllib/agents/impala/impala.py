@@ -14,6 +14,16 @@ from .vtrace_policy import DEFAULT_CONFIG, VTraceJaxPolicy
 
 
 def make_async_optimizer(workers, config):
+    if config.get("anakin"):
+        from ...env.jax_env import make_jax_env
+        from ...optimizers.anakin_optimizer import AnakinOptimizer
+        return AnakinOptimizer(
+            workers,
+            jax_env=make_jax_env(config["env"], config.get("env_config")),
+            num_envs=config["_anakin_num_envs"],
+            rollout_fragment_length=config["rollout_fragment_length"],
+            updates_per_call=config.get("anakin_updates_per_call", 10),
+            seed=config.get("seed") or 0)
     return AsyncSamplesOptimizer(
         workers,
         train_batch_size=config["train_batch_size"],
@@ -26,10 +36,45 @@ def make_async_optimizer(workers, config):
         sgd_minibatch_size=config.get("sgd_minibatch_size", 0),
         # Minibatches shuffle/slice at fragment granularity so V-trace's
         # [B, T] reshape stays valid.
-        sgd_sequence_length=config["rollout_fragment_length"])
+        sgd_sequence_length=config["rollout_fragment_length"],
+        # Sebulba inline actors: batched TPU inference on the learner
+        # process (see `InlineActorThread`).
+        num_inline_actors=config.get("num_inline_actors", 0),
+        inline_env=config.get("env"),
+        inline_num_envs=config.get("_inline_num_envs", 1),
+        inline_env_config=config.get("env_config"),
+        inline_seed=config.get("seed"))
 
 
 def validate_config(config):
+    if config.get("num_inline_actors"):
+        if config.get("num_workers"):
+            raise ValueError(
+                "num_inline_actors and num_workers are alternative "
+                "sampling architectures; set num_workers=0 for the "
+                "inline (Sebulba) path or num_inline_actors=0 for "
+                "remote rollout workers")
+        if config.get("anakin"):
+            raise ValueError(
+                "num_inline_actors is ignored in anakin mode — the "
+                "fused program does its own device-resident rollouts")
+        # Inline actors own the real env batch; the local RolloutWorker
+        # keeps a single probe env (spaces only).
+        config["_inline_num_envs"] = config.get("num_envs_per_worker", 1)
+        config["num_envs_per_worker"] = 1
+    if config.get("anakin"):
+        if config.get("num_workers"):
+            raise ValueError(
+                "anakin mode is fully device-resident; num_workers must "
+                "be 0 (env slots come from num_envs_per_worker)")
+        if (config.get("model") or {}).get("use_lstm"):
+            raise ValueError(
+                "anakin mode currently supports feedforward policies "
+                "only; use the inline-actor (Sebulba) path for LSTM")
+        # The device-resident env slots are the optimizer's; the local
+        # RolloutWorker keeps a single probe env (spaces only).
+        config["_anakin_num_envs"] = config.get("num_envs_per_worker", 1)
+        config["num_envs_per_worker"] = 1
     if (config.get("model") or {}).get("use_lstm"):
         # Recurrent IMPALA trains on the packed fragments themselves:
         # one fragment = one LSTM sequence.

@@ -45,6 +45,12 @@ DEFAULT_CONFIG = with_common_config({
     # 0 = one full-batch update per train batch; >0 enables the fused
     # minibatch-SGD program (must be a multiple of rollout_fragment_length).
     "sgd_minibatch_size": 0,
+    # Anakin mode (`optimizers/anakin_optimizer.py`): env + rollout +
+    # V-trace update fused into one XLA program. Requires a JaxEnv
+    # registration for config["env"] (`env/jax_env.py`); env slots =
+    # num_envs_per_worker, batch-sharded over the learner mesh.
+    "anakin": False,
+    "anakin_updates_per_call": 10,
 })
 
 
@@ -55,36 +61,51 @@ def _time_major(x, seq_len: int):
     return jnp.swapaxes(x, 0, 1)
 
 
-def vtrace_loss(policy, params, batch, rng, loss_state):
-    cfg = policy.config
-    T = cfg["rollout_fragment_length"]
-    gamma = cfg["gamma"]
+def forward_with_bootstrap(policy, params, batch, T: int):
+    """Model forward over a packed [B*T] fragment batch plus the
+    per-fragment bootstrap value.
 
+    Handles both fragment-batch layouts: a BOOTSTRAP_OBS column of shape
+    [B, ...] (VectorSampler / Anakin batches), or a full per-row NEW_OBS
+    column whose last row per fragment is the bootstrap observation
+    (remote-worker pack mode). Returns (dist_inputs[B*T, O],
+    values[B*T], bootstrap_value[B]).
+    """
     if policy.recurrent:
-        # LSTM scan over the packed [B, T] fragments with per-fragment
-        # initial state and done-driven resets (the reference's IMPALA is
-        # LSTM-first; here the whole recurrent forward fuses into the
-        # V-trace program).
         dist_bt, val_bt, carry = policy.apply_sequences(params, batch)
         dist_inputs = dist_bt.reshape(-1, dist_bt.shape[-1])
         values_flat = val_bt.reshape(-1)
-        # Bootstrap: one more LSTM step from the final carry on each
-        # fragment's last NEW_OBS (reset if that step ended an episode —
-        # its value is then V(s0) of the next episode, matching discount
-        # 0 at the boundary).
-        new_obs = batch[sb.NEW_OBS]
-        B = new_obs.shape[0] // T
-        last_new_obs = new_obs.reshape((B, T) + new_obs.shape[1:])[:, -1]
+        B = batch[sb.OBS].shape[0] // T
+        if sb.BOOTSTRAP_OBS in batch:
+            last_new_obs = batch[sb.BOOTSTRAP_OBS]
+        else:
+            new_obs = batch[sb.NEW_OBS]
+            last_new_obs = new_obs.reshape(
+                (B, T) + new_obs.shape[1:])[:, -1]
+        # One more step from the final carry (reset where the fragment's
+        # last step was terminal: the bootstrap is then V(s0) of the next
+        # episode, masked anyway by discount 0 at the boundary).
         last_done = batch[sb.DONES].reshape(B, T)[:, -1]
         _, boot_bt, _ = policy.apply(
             params, last_new_obs[:, None], carry, last_done[:, None])
         bootstrap_value = boot_bt[:, 0]
     else:
         dist_inputs, values_flat = policy.apply(params, batch[sb.OBS])
-        # Bootstrap: value of the observation after each sequence's last
-        # step, under the current (target) policy.
-        new_obs_tb = _time_major(batch[sb.NEW_OBS], T)
-        _, bootstrap_value = policy.apply(params, new_obs_tb[-1])
+        if sb.BOOTSTRAP_OBS in batch:
+            boot_obs = batch[sb.BOOTSTRAP_OBS]
+        else:
+            boot_obs = _time_major(batch[sb.NEW_OBS], T)[-1]
+        _, bootstrap_value = policy.apply(params, boot_obs)
+    return dist_inputs, values_flat, bootstrap_value
+
+
+def vtrace_loss(policy, params, batch, rng, loss_state):
+    cfg = policy.config
+    T = cfg["rollout_fragment_length"]
+    gamma = cfg["gamma"]
+
+    dist_inputs, values_flat, bootstrap_value = forward_with_bootstrap(
+        policy, params, batch, T)
 
     behaviour_logits = _time_major(batch[sb.ACTION_DIST_INPUTS], T)
     target_logits = _time_major(dist_inputs, T)

@@ -17,7 +17,7 @@ from ... import sample_batch as sb
 from ...policy.jax_policy_template import build_jax_policy
 from ..impala import vtrace
 from ..impala.impala import make_async_optimizer, validate_config
-from ..impala.vtrace_policy import _time_major
+from ..impala.vtrace_policy import _time_major, forward_with_bootstrap
 from ..trainer import with_common_config
 from ..trainer_template import build_trainer
 
@@ -54,21 +54,8 @@ def appo_loss(policy, params, batch, rng, loss_state):
     T = cfg["rollout_fragment_length"]
     gamma = cfg["gamma"]
 
-    if policy.recurrent:
-        dist_bt, val_bt, carry = policy.apply_sequences(params, batch)
-        dist_inputs = dist_bt.reshape(-1, dist_bt.shape[-1])
-        values_flat = val_bt.reshape(-1)
-        new_obs = batch[sb.NEW_OBS]
-        B = new_obs.shape[0] // T
-        last_new_obs = new_obs.reshape((B, T) + new_obs.shape[1:])[:, -1]
-        last_done = batch[sb.DONES].reshape(B, T)[:, -1]
-        _, boot_bt, _ = policy.apply(
-            params, last_new_obs[:, None], carry, last_done[:, None])
-        bootstrap_value = boot_bt[:, 0]
-    else:
-        dist_inputs, values_flat = policy.apply(params, batch[sb.OBS])
-        new_obs_tb = _time_major(batch[sb.NEW_OBS], T)
-        _, bootstrap_value = policy.apply(params, new_obs_tb[-1])
+    dist_inputs, values_flat, bootstrap_value = forward_with_bootstrap(
+        policy, params, batch, T)
 
     behaviour_logits = _time_major(batch[sb.ACTION_DIST_INPUTS], T)
     target_logits = _time_major(dist_inputs, T)

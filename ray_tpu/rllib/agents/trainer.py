@@ -33,6 +33,12 @@ COMMON_CONFIG = {
     # === Rollouts ===
     "num_workers": 0,
     "num_envs_per_worker": 1,
+    # Sebulba inline actors: threads on the learner process stepping a
+    # BatchedEnv with TPU-batched inference (num_envs_per_worker env
+    # slots each). The TPU-native answer to "the chip starves behind
+    # remote CPU-inference workers" — see
+    # `optimizers/async_samples_optimizer.py:InlineActorThread`.
+    "num_inline_actors": 0,
     "rollout_fragment_length": 200,
     "batch_mode": "truncate_episodes",
     "horizon": None,
@@ -161,6 +167,9 @@ class Trainer(Trainable):
 
     def _result_from_optimizer(self, optimizer, extra: dict = None) -> dict:
         episodes = collect_episodes(self.workers)
+        inline = getattr(optimizer, "inline_episodes", None)
+        if inline is not None:
+            episodes.extend(inline())
         self._episode_history = getattr(self, "_episode_history", [])
         result = summarize_episodes(
             episodes, smoothed=self._episode_history)

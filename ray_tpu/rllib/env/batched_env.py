@@ -1,0 +1,215 @@
+"""Batched environments: N env slots stepped as one vectorized call.
+
+The reference scales sampling by adding processes (`num_workers` rollout
+actors, `rllib/evaluation/rollout_worker.py:55`) because per-env Python
+stepping is the unit of work. On a TPU host the economics invert: the
+chip does batched inference for thousands of env slots, so the env itself
+must step as a batch with O(1) Python per step — the Sebulba/Podracer
+actor shape (SURVEY.md §7.1). This module is the env-side half of that
+design: `vector_step` takes an action batch and returns (obs, rewards,
+dones) arrays with auto-reset (a done slot's returned obs is the first
+observation of its next episode).
+
+`BatchedEnvFromSingle` adapts any registered single env so every env
+works in the inline-actor path; the built-in hot envs (SyntheticAtari,
+CartPole) have natively vectorized implementations.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+from .env import Env
+from .spaces import Box, Discrete
+
+
+class BatchedEnv:
+    """N env slots stepped as a batch.
+
+    Contract:
+      - `vector_reset() -> obs[N, ...]` resets every slot.
+      - `vector_step(actions[N]) -> (obs[N,...], rewards[N], dones[N])`
+        steps every slot; slots that finished an episode this step report
+        done=True and their returned obs row is the NEXT episode's first
+        observation (auto-reset). The terminal observation itself is never
+        surfaced — V-trace/GAE cut the discount at dones, so only the
+        post-reset obs is ever consumed (as the next step's input and as
+        a bootstrap row whose value is masked by discount 0).
+    """
+
+    num_envs: int = 0
+    observation_space = None
+    action_space = None
+
+    def vector_reset(self) -> np.ndarray:
+        raise NotImplementedError
+
+    def vector_step(self, actions):
+        raise NotImplementedError
+
+    def seed(self, seed=None):
+        self._rng = np.random.default_rng(seed)
+
+    def close(self):
+        pass
+
+
+class BatchedEnvFromSingle(BatchedEnv):
+    """Fallback adapter: N copies of a single `Env` stepped in a loop."""
+
+    def __init__(self, make_env: Callable[[], Env], num_envs: int):
+        self.envs = [make_env() for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.observation_space = self.envs[0].observation_space
+        self.action_space = self.envs[0].action_space
+
+    def seed(self, seed=None):
+        for i, e in enumerate(self.envs):
+            e.seed(None if seed is None else seed + i)
+
+    def vector_reset(self):
+        return np.stack([e.reset() for e in self.envs])
+
+    def vector_step(self, actions):
+        obs = [None] * self.num_envs
+        rewards = np.zeros(self.num_envs, np.float32)
+        dones = np.zeros(self.num_envs, bool)
+        for i, (e, a) in enumerate(zip(self.envs, actions)):
+            o, r, d, _ = e.step(a)
+            if d:
+                o = e.reset()
+            obs[i] = o
+            rewards[i] = r
+            dones[i] = d
+        return np.stack(obs), rewards, dones
+
+    def close(self):
+        for e in self.envs:
+            e.close()
+
+
+class BatchedSyntheticAtari(BatchedEnv):
+    """Vectorized SyntheticAtari (see `env.py:SyntheticAtari`): Atari-shaped
+    84x84x4 uint8 frames whose intensity band encodes the rewarded action.
+
+    Frame generation is the dominant cost of the single-env version
+    (~28 KiB of fresh RNG output per step). Here frames come from a
+    precomputed noise pool with the action band already stamped per
+    action: one gather-copy per step for the whole batch, so a single
+    CPU core can feed tens of thousands of steps per second while the
+    signal (band position -> best action) stays fully learnable.
+    """
+
+    def __init__(self, num_envs: int, episode_len: int = 1000,
+                 num_actions: int = 6, pool_size: int = 32,
+                 seed=None):
+        self.num_envs = num_envs
+        self.episode_len = episode_len
+        self.num_actions = num_actions
+        self.pool_size = pool_size
+        self.observation_space = Box(0, 255, shape=(84, 84, 4),
+                                     dtype=np.uint8)
+        self.action_space = Discrete(num_actions)
+        self._rng = np.random.default_rng(seed)
+        self._build_pool()
+        self._t = np.zeros(num_envs, np.int64)
+        self._target = self._rng.integers(0, num_actions, size=num_envs)
+
+    def _build_pool(self):
+        base = self._rng.integers(
+            0, 64, size=(self.pool_size, 84, 84, 4), dtype=np.uint8)
+        band = 84 // self.num_actions
+        pool = np.broadcast_to(
+            base, (self.num_actions,) + base.shape).copy()
+        for a in range(self.num_actions):
+            pool[a, :, a * band:(a + 1) * band, :, :] += 128
+        self._pool = pool  # [A, P, 84, 84, 4]
+
+    def seed(self, seed=None):
+        self._rng = np.random.default_rng(seed)
+        self._build_pool()
+
+    def _frames(self):
+        idx = self._rng.integers(0, self.pool_size, size=self.num_envs)
+        return self._pool[self._target, idx]
+
+    def vector_reset(self):
+        self._t[:] = 0
+        self._target = self._rng.integers(
+            0, self.num_actions, size=self.num_envs)
+        return self._frames()
+
+    def vector_step(self, actions):
+        rewards = (np.asarray(actions) == self._target).astype(np.float32)
+        self._t += 1
+        dones = self._t >= self.episode_len
+        if dones.any():
+            self._t[dones] = 0
+        # Target re-randomizes every step (same as the single-env version),
+        # so reset and non-reset slots draw from the same distribution.
+        self._target = self._rng.integers(
+            0, self.num_actions, size=self.num_envs)
+        return self._frames(), rewards, dones
+
+
+class BatchedCartPole(BatchedEnv):
+    """Vectorized CartPole with the same dynamics/termination as
+    `env.py:CartPole` (gym CartPole-v0 semantics)."""
+
+    def __init__(self, num_envs: int, max_steps: int = 200, seed=None):
+        self.num_envs = num_envs
+        self.max_steps = max_steps
+        self.gravity = 9.8
+        self.masscart, self.masspole = 1.0, 0.1
+        self.total_mass = self.masscart + self.masspole
+        self.length = 0.5
+        self.polemass_length = self.masspole * self.length
+        self.force_mag = 10.0
+        self.tau = 0.02
+        self.theta_threshold = 12 * 2 * np.pi / 360
+        self.x_threshold = 2.4
+        high = np.array([self.x_threshold * 2, np.finfo(np.float32).max,
+                         self.theta_threshold * 2, np.finfo(np.float32).max],
+                        dtype=np.float32)
+        self.observation_space = Box(-high, high)
+        self.action_space = Discrete(2)
+        self._rng = np.random.default_rng(seed)
+        self._state = np.zeros((num_envs, 4))
+        self._t = np.zeros(num_envs, np.int64)
+
+    def _reset_rows(self, mask):
+        n = int(mask.sum())
+        self._state[mask] = self._rng.uniform(-0.05, 0.05, size=(n, 4))
+        self._t[mask] = 0
+
+    def vector_reset(self):
+        self._reset_rows(np.ones(self.num_envs, bool))
+        return self._state.astype(np.float32)
+
+    def vector_step(self, actions):
+        x, x_dot, theta, theta_dot = self._state.T
+        force = np.where(np.asarray(actions) == 1,
+                         self.force_mag, -self.force_mag)
+        costheta, sintheta = np.cos(theta), np.sin(theta)
+        temp = (force + self.polemass_length * theta_dot ** 2 * sintheta) \
+            / self.total_mass
+        thetaacc = (self.gravity * sintheta - costheta * temp) / (
+            self.length * (4.0 / 3.0
+                           - self.masspole * costheta ** 2 / self.total_mass))
+        xacc = temp - self.polemass_length * thetaacc * costheta \
+            / self.total_mass
+        x = x + self.tau * x_dot
+        x_dot = x_dot + self.tau * xacc
+        theta = theta + self.tau * theta_dot
+        theta_dot = theta_dot + self.tau * thetaacc
+        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        self._t += 1
+        dones = ((np.abs(x) > self.x_threshold)
+                 | (np.abs(theta) > self.theta_threshold)
+                 | (self._t >= self.max_steps))
+        rewards = np.ones(self.num_envs, np.float32)
+        if dones.any():
+            self._reset_rows(dones)
+        return self._state.astype(np.float32), rewards, dones

@@ -32,6 +32,59 @@ def registered_envs():
     return sorted(_REGISTRY)
 
 
+# -- batched (vectorized) envs ------------------------------------------
+# The Sebulba inline-actor path steps envs as a batch (see
+# `batched_env.py`). Envs with a natively-vectorized implementation
+# register it here; everything else falls back to a per-env loop adapter.
+_BATCHED_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_batched_env(name: str, creator: Callable) -> None:
+    """Register `creator(num_envs, env_config) -> BatchedEnv`."""
+    _BATCHED_REGISTRY[name] = creator
+
+
+def make_batched_env(name, num_envs: int, env_config: dict = None,
+                     seed=None):
+    """Build a BatchedEnv for `name` (string id or env creator callable).
+
+    Uses the natively-vectorized implementation when one is registered;
+    otherwise wraps N single-env instances (`BatchedEnvFromSingle`).
+    """
+    from .batched_env import BatchedEnvFromSingle
+    env_config = env_config or {}
+    if isinstance(name, str) and name in _BATCHED_REGISTRY:
+        env = _BATCHED_REGISTRY[name](num_envs, env_config)
+    elif isinstance(name, str):
+        env = BatchedEnvFromSingle(
+            lambda: make_env(name, env_config), num_envs)
+    else:  # creator callable
+        env = BatchedEnvFromSingle(lambda: name(env_config), num_envs)
+    if seed is not None:
+        env.seed(seed)
+    return env
+
+
+def _batched_synthetic_atari(n, cfg):
+    from .batched_env import BatchedSyntheticAtari
+    return BatchedSyntheticAtari(
+        n, episode_len=cfg.get("episode_len", 1000),
+        num_actions=cfg.get("num_actions", 6),
+        pool_size=cfg.get("pool_size", 32))
+
+
+def _batched_cartpole(max_steps):
+    def creator(n, cfg):
+        from .batched_env import BatchedCartPole
+        return BatchedCartPole(n, max_steps=max_steps)
+    return creator
+
+
+register_batched_env("SyntheticAtari-v0", _batched_synthetic_atari)
+register_batched_env("CartPole-v0", _batched_cartpole(200))
+register_batched_env("CartPole-v1", _batched_cartpole(500))
+
+
 # Built-ins (same ids the reference's yamls use).
 register_env("CartPole-v0", lambda cfg: CartPole(max_steps=200))
 register_env("CartPole-v1", lambda cfg: CartPole(max_steps=500))

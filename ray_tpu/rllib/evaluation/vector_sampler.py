@@ -1,0 +1,128 @@
+"""Packed vectorized sampler: the Sebulba-side env loop.
+
+Parity target: the reference's `_env_runner` pack mode
+(`rllib/evaluation/sampler.py:226`) — every env slot emits exactly T
+contiguous steps per sample(), crossing episode boundaries (dones mark
+the resets inside). The TPU re-architecture replaces its per-env Python
+row-building with whole-batch column buffering: one `compute_actions`
+per step covering all N env slots (a single jitted device call), numpy
+bookkeeping for episode metrics, and one transpose+reshape at fragment
+end. Python cost per step is O(1) in the number of envs, which is what
+lets a 1-core host feed a TPU learner (VERDICT.md round-2 headline gap).
+
+Output layout: a flat [N*T] SampleBatch where rows [i*T:(i+1)*T] are env
+slot i's fragment, the layout `vtrace_policy.py` reshapes to [B, T].
+Instead of a full NEW_OBS column (which would double host->device obs
+traffic), the batch carries a BOOTSTRAP_OBS column of shape [N, ...]:
+each fragment's post-last-step observation, exactly what the V-trace
+bootstrap needs (`vtrace_policy.py` bootstrap handling).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from .. import sample_batch as sb
+from ..sample_batch import SampleBatch
+from .sampler import RolloutMetrics
+
+
+class VectorSampler:
+    """Steps a BatchedEnv for T steps per sample(), fully packed."""
+
+    def __init__(self, batched_env, policy,
+                 rollout_fragment_length: int,
+                 explore: bool = True,
+                 eps_id_offset: int = 0):
+        self.env = batched_env
+        self.policy = policy
+        self.T = rollout_fragment_length
+        self.explore = explore
+        n = self.env.num_envs
+        self._obs = np.asarray(self.env.vector_reset())
+        self._ep_rew = np.zeros(n, np.float64)
+        self._ep_len = np.zeros(n, np.int64)
+        # Episode ids: unique across sampler instances via the offset
+        # (inline actors pass k * 2**40).
+        self._eps_counter = eps_id_offset
+        self._cur_eps = self._next_eps_ids(n)
+        self.metrics: List[RolloutMetrics] = []
+        get_init = getattr(policy, "get_initial_state", None)
+        self._rnn_state = list(get_init(n)) if get_init is not None else []
+
+    def _next_eps_ids(self, k: int) -> np.ndarray:
+        ids = self._eps_counter + np.arange(k, dtype=np.int64)
+        self._eps_counter += k
+        return ids
+
+    def sample(self) -> SampleBatch:
+        N, T = self.env.num_envs, self.T
+        act_buf, rew_buf, done_buf = [], [], []
+        extra_buf = {}
+        eps_ids = np.empty((T, N), np.int64)
+        ts = np.empty((T, N), np.int64)
+        recurrent = bool(self._rnn_state)
+        # Observations dominate batch bytes (e.g. 28 KiB/step for Atari):
+        # write them straight into the final env-major [N, T, ...] layout
+        # instead of stack+transpose+reshape (one copy, not two). A fresh
+        # buffer per call — the previous batch may still sit in the
+        # learner queue.
+        obs_out = np.empty((N, T) + self._obs.shape[1:], self._obs.dtype)
+
+        for t in range(T):
+            obs = self._obs
+            actions, state_out, extra = self.policy.compute_actions(
+                obs, state_batches=self._rnn_state, explore=self.explore)
+            next_obs, rewards, dones = self.env.vector_step(actions)
+            obs_out[:, t] = obs
+            act_buf.append(actions)
+            rew_buf.append(rewards.astype(np.float32, copy=False))
+            done_buf.append(dones)
+            eps_ids[t] = self._cur_eps
+            ts[t] = self._ep_len
+            for k, v in extra.items():
+                extra_buf.setdefault(k, []).append(v)
+            self._ep_rew += rewards
+            self._ep_len += 1
+            if recurrent:
+                state_out = [np.array(s) for s in state_out]
+            if dones.any():
+                done_idx = np.nonzero(dones)[0]
+                for i in done_idx:
+                    self.metrics.append(RolloutMetrics(
+                        int(self._ep_len[i]), float(self._ep_rew[i])))
+                self._ep_rew[dones] = 0.0
+                self._ep_len[dones] = 0
+                self._cur_eps[dones] = self._next_eps_ids(len(done_idx))
+                # Auto-reset already happened inside the env; zero the
+                # RNN state for the fresh episodes.
+                for s in state_out:
+                    s[dones] = 0.0
+            if recurrent:
+                self._rnn_state = state_out
+            self._obs = np.asarray(next_obs)
+
+        def pack(bufs):
+            a = np.stack(bufs)  # [T, N, ...]
+            return np.swapaxes(a, 0, 1).reshape((N * T,) + a.shape[2:])
+
+        out = {
+            sb.OBS: obs_out.reshape((N * T,) + obs_out.shape[2:]),
+            sb.ACTIONS: pack(act_buf),
+            sb.REWARDS: pack(rew_buf),
+            sb.DONES: pack(done_buf),
+            sb.EPS_ID: np.swapaxes(eps_ids, 0, 1).reshape(-1),
+            sb.T: np.swapaxes(ts, 0, 1).reshape(-1),
+            # Per-fragment bootstrap observation (post-last-step obs).
+            sb.BOOTSTRAP_OBS: self._obs.copy(),
+        }
+        for k, bufs in extra_buf.items():
+            out[k] = pack(bufs)
+        return SampleBatch(out)
+
+    def get_metrics(self) -> List[RolloutMetrics]:
+        out = self.metrics
+        self.metrics = []
+        return out

@@ -1,0 +1,221 @@
+"""Anakin optimizer: env + inference + learner fused into one XLA program.
+
+The reference's IMPALA moves every observation across process and host
+boundaries: env -> rollout worker -> object store -> learner GPU
+(`rllib/optimizers/async_samples_optimizer.py:19`). On TPU hosts where
+the host<->device link is the bottleneck, the idiomatic design inverts:
+for envs expressible as pure JAX functions (`env/jax_env.py`), the
+WHOLE actor-learner loop — `lax.scan` over env steps with policy
+inference, then the V-trace update — compiles into a single donated-
+buffer XLA program. Observations live and die in HBM; the host only
+dispatches the program and reads back scalar stats. This is the
+"Anakin" architecture of the Podracer line of work (see PAPERS.md),
+and it composes with the device mesh: env slots are batch-sharded
+across chips, params replicated, gradient all-reduce inserted by XLA —
+the same sharding contract as `JaxPolicy._train_fn`.
+
+Semantics: on-policy IMPALA — each scan iteration rolls out under the
+current params and immediately updates them, so V-trace's importance
+ratios are 1 and the correction is a no-op. The V-trace loss program is
+kept anyway: it is byte-for-byte the same loss the async (Sebulba /
+remote-worker) paths feed off-policy, so one loss serves two feeding
+architectures and the correction engages automatically wherever rollout
+and learner params diverge.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from .. import sample_batch as sb
+from .policy_optimizer import PolicyOptimizer
+
+
+class AnakinOptimizer(PolicyOptimizer):
+    """Fused device-resident IMPALA (see module docstring)."""
+
+    def __init__(self, workers, jax_env, num_envs: int,
+                 rollout_fragment_length: int,
+                 updates_per_call: int = 10,
+                 seed: int = 0):
+        super().__init__(workers)
+        self.policy = workers.local_worker.policy
+        self.env = jax_env
+        self.num_envs = num_envs
+        self.T = rollout_fragment_length
+        self.updates_per_call = updates_per_call
+        self.learner_stats: Dict = {}
+        self._ep_reward_mean = float("nan")
+        self._ep_len_mean = float("nan")
+        self._episodes_total = 0
+        self._grad_time_total = 0.0
+        self._grad_calls = 0
+
+        policy = self.policy
+        mesh_size = int(policy.mesh.devices.size) \
+            if policy.mesh is not None else 1
+        if num_envs % max(1, mesh_size):
+            raise ValueError(
+                f"num_envs ({num_envs}) must divide evenly across the "
+                f"learner mesh ({mesh_size} devices)")
+
+        # Device-resident env state: one slot per env, batch-sharded.
+        vreset = jax.vmap(self.env.reset)
+        init_keys = jax.random.split(jax.random.PRNGKey(seed), num_envs)
+        env_state, obs = jax.jit(
+            vreset, out_shardings=(policy._bsharded, policy._bsharded))(
+                init_keys)
+        self._env_state = env_state
+        self._obs = obs
+        self._rng = jax.device_put(
+            jax.random.PRNGKey(seed + 1), policy._repl)
+        self._ep_rew = jax.device_put(
+            jnp.zeros(num_envs, jnp.float32), policy._bsharded)
+        self._ep_len = jax.device_put(
+            jnp.zeros(num_envs, jnp.int32), policy._bsharded)
+        self._anakin_fn = self._build_fn()
+
+    # ------------------------------------------------------------------
+    def _build_fn(self):
+        policy = self.policy
+        env = self.env
+        N, T, M = self.num_envs, self.T, self.updates_per_call
+        vstep = jax.vmap(env.step)
+
+        def em(x):
+            """[T, N, ...] -> env-major flat [N*T, ...]."""
+            return jnp.swapaxes(x, 0, 1).reshape((N * T,) + x.shape[2:])
+
+        def one_update(carry, _):
+            (params, opt_state, env_state, obs, rng,
+             ep_rew, ep_len, ep_acc) = carry
+
+            def step_fn(scarry, _):
+                env_state, obs, rng, ep_rew, ep_len, ep_acc = scarry
+                rng, akey, ekey = jax.random.split(rng, 3)
+                dist_inputs, _ = policy.apply(params, obs)
+                action = policy.dist_class(dist_inputs).sample(akey)
+                env_state, next_obs, reward, done = vstep(
+                    env_state, action, jax.random.split(ekey, N))
+                # Episode bookkeeping (completed-episode sums + counts).
+                ep_rew = ep_rew + reward
+                ep_len = ep_len + 1
+                donef = done.astype(jnp.float32)
+                ep_acc = (ep_acc[0] + jnp.sum(donef * ep_rew),
+                          ep_acc[1] + jnp.sum(donef * ep_len),
+                          ep_acc[2] + jnp.sum(donef))
+                ep_rew = jnp.where(done, 0.0, ep_rew)
+                ep_len = jnp.where(done, 0, ep_len)
+                out = (obs, action, reward, done, dist_inputs)
+                return (env_state, next_obs, rng, ep_rew, ep_len,
+                        ep_acc), out
+
+            (env_state, obs, rng, ep_rew, ep_len, ep_acc), traj = \
+                jax.lax.scan(
+                    step_fn,
+                    (env_state, obs, rng, ep_rew, ep_len, ep_acc),
+                    None, length=T)
+            obs_t, act_t, rew_t, done_t, logits_t = traj
+            batch = {
+                sb.OBS: em(obs_t),
+                sb.ACTIONS: em(act_t),
+                sb.REWARDS: em(rew_t),
+                sb.DONES: em(done_t).astype(jnp.float32),
+                sb.ACTION_DIST_INPUTS: em(logits_t),
+                # Behaviour log-probs equal target log-probs on-policy;
+                # losses that want them recompute from the logits.
+                sb.BOOTSTRAP_OBS: obs,
+            }
+            rng, lkey = jax.random.split(rng)
+            (loss, stats), grads = jax.value_and_grad(
+                policy._loss_fn, argnums=1, has_aux=True)(
+                    policy, params, batch, lkey, policy.loss_state)
+            updates, opt_state = policy.optimizer.update(
+                grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return (params, opt_state, env_state, obs, rng,
+                    ep_rew, ep_len, ep_acc), stats
+
+        def anakin_fn(params, opt_state, env_state, obs, rng,
+                      ep_rew, ep_len):
+            ep_acc = (jnp.zeros((), jnp.float32),
+                      jnp.zeros((), jnp.float32),
+                      jnp.zeros((), jnp.float32))
+            carry, stats = jax.lax.scan(
+                one_update,
+                (params, opt_state, env_state, obs, rng,
+                 ep_rew, ep_len, ep_acc),
+                None, length=M)
+            (params, opt_state, env_state, obs, rng,
+             ep_rew, ep_len, ep_acc) = carry
+            # Mean over the M updates for scalar stats.
+            stats = jax.tree.map(lambda x: jnp.mean(x), stats)
+            stats["_ep_reward_sum"] = ep_acc[0]
+            stats["_ep_len_sum"] = ep_acc[1]
+            stats["_ep_count"] = ep_acc[2]
+            return params, opt_state, env_state, obs, rng, ep_rew, \
+                ep_len, stats
+
+        repl, bshard = policy._repl, policy._bsharded
+        return jax.jit(
+            anakin_fn,
+            donate_argnums=(0, 1, 2, 3, 4, 5, 6),
+            in_shardings=(repl, repl, bshard, bshard, repl, bshard,
+                          bshard),
+            out_shardings=(repl, repl, bshard, bshard, repl, bshard,
+                           bshard, repl))
+
+    # ------------------------------------------------------------------
+    def step(self) -> dict:
+        policy = self.policy
+        t0 = time.perf_counter()
+        with policy._update_lock:
+            (policy.params, policy.opt_state, self._env_state, self._obs,
+             self._rng, self._ep_rew, self._ep_len, stats) = \
+                self._anakin_fn(
+                    policy.params, policy.opt_state, self._env_state,
+                    self._obs, self._rng, self._ep_rew, self._ep_len)
+            stats = {k: float(v) for k, v in stats.items()}
+        self._grad_time_total += time.perf_counter() - t0
+        self._grad_calls += 1
+        n = self.updates_per_call * self.num_envs * self.T
+        self.num_steps_sampled += n
+        self.num_steps_trained += n
+        policy.global_timestep += n
+        cnt = stats.pop("_ep_count")
+        rew_sum = stats.pop("_ep_reward_sum")
+        len_sum = stats.pop("_ep_len_sum")
+        if cnt > 0:
+            self._ep_reward_mean = rew_sum / cnt
+            self._ep_len_mean = len_sum / cnt
+            self._episodes_total += int(cnt)
+        self.learner_stats = stats
+        return stats
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update({
+            "anakin": True,
+            "updates_per_call": self.updates_per_call,
+            # Episode metrics are device-aggregated (sum/count), not
+            # per-episode records — the mean overrides the (empty)
+            # sampler summary in Trainer results.
+            "episode_reward_mean": self._ep_reward_mean,
+            "episode_len_mean": self._ep_len_mean,
+            "episodes_total": self._episodes_total,
+            "timing": {
+                "anakin_call_time_ms": round(
+                    1000 * self._grad_time_total
+                    / max(1, self._grad_calls), 3),
+            },
+        })
+        return out
+
+    def stop(self):
+        pass

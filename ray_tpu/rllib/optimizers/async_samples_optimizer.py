@@ -54,6 +54,7 @@ class LearnerThread(threading.Thread):
         self.stopped = False
         self.weights_updated = False
         self.stats = {}
+        self.error = None  # first exception that killed the thread
         self.learner_queue_size = WindowStat("learner_queue_size", 50)
         self.queue_timer = _Timer()
         self.grad_timer = _Timer()
@@ -61,7 +62,12 @@ class LearnerThread(threading.Thread):
 
     def run(self):
         while not self.stopped:
-            self.step()
+            try:
+                self.step()
+            except Exception as e:  # noqa: BLE001 — surfaced to driver
+                logger.exception("learner thread died")
+                self.error = e
+                self.stopped = True
 
     def step(self):
         with self.queue_timer:
@@ -89,6 +95,40 @@ class LearnerThread(threading.Thread):
         self.stopped = True
 
 
+class InlineActorThread(threading.Thread):
+    """Sebulba-style inline actor: steps a BatchedEnv on this process,
+    with inference batched through the LEARNER's TPU policy (the policy's
+    `_update_lock` serializes dispatch against concurrent updates), and
+    feeds packed fragments straight into the learner queue.
+
+    Replaces remote CPU-inference rollout workers on hosts where the
+    chip would otherwise starve (VERDICT.md round-2 headline gap): no
+    object-store hop, no weight broadcasts (the actor always reads the
+    live params), one jitted inference call per step for all env slots.
+    """
+
+    def __init__(self, sampler, learner: LearnerThread):
+        super().__init__(daemon=True, name="inline-actor")
+        self.sampler = sampler
+        self.learner = learner
+        self.stopped = False
+        self.steps_sampled = 0  # monotonic; read without lock (int swap)
+
+    def run(self):
+        while not self.stopped:
+            batch = self.sampler.sample()
+            self.steps_sampled += batch.count
+            while not self.stopped:
+                try:
+                    self.learner.inqueue.put(batch, timeout=1.0)
+                    break
+                except queue.Full:
+                    continue
+
+    def stop(self):
+        self.stopped = True
+
+
 class AsyncSamplesOptimizer(PolicyOptimizer):
     """Keep workers sampling continuously; learn as batches arrive."""
 
@@ -100,7 +140,12 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                  learner_queue_size: int = LEARNER_QUEUE_MAX_SIZE,
                  num_sgd_iter: int = 1,
                  sgd_minibatch_size: int = 0,
-                 sgd_sequence_length: int = 1):
+                 sgd_sequence_length: int = 1,
+                 num_inline_actors: int = 0,
+                 inline_env=None,
+                 inline_num_envs: int = 1,
+                 inline_env_config=None,
+                 inline_seed=None):
         super().__init__(workers)
         self.train_batch_size = train_batch_size
         self.rollout_fragment_length = rollout_fragment_length
@@ -121,6 +166,34 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         self.num_steps_since_broadcast = 0
         self._broadcasted_weights = None
         self.learner_stats = {}
+        self._inline_actors: List[InlineActorThread] = []
+        self._inline_sampled_seen = 0
+        self._compiled = False
+
+        if num_inline_actors > 0:
+            from ..env.registry import make_batched_env
+            from ..evaluation.vector_sampler import VectorSampler
+            policy = workers.local_worker.policy
+            mesh = getattr(policy, "mesh", None)
+            mesh_size = int(mesh.devices.size) if mesh is not None else 1
+            if inline_num_envs % max(1, mesh_size):
+                raise ValueError(
+                    f"num_envs_per_worker ({inline_num_envs}) must divide "
+                    f"evenly across the learner mesh ({mesh_size} devices)"
+                    " — fragment batches (and their per-fragment bootstrap"
+                    " rows) are batch-sharded over the mesh")
+            for k in range(num_inline_actors):
+                benv = make_batched_env(
+                    inline_env, inline_num_envs, inline_env_config,
+                    seed=None if inline_seed is None
+                    else inline_seed + 1000 * (k + 1))
+                sampler = VectorSampler(
+                    benv, policy, rollout_fragment_length,
+                    eps_id_offset=(k + 1) << 40)
+                self._inline_actors.append(
+                    InlineActorThread(sampler, self.learner))
+            for a in self._inline_actors:
+                a.start()
 
         if workers.remote_workers:
             self._broadcast_weights()
@@ -136,6 +209,8 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         self.num_steps_since_broadcast = 0
 
     def step(self) -> dict:
+        if self._inline_actors:
+            return self._step_inline()
         if not self.workers.remote_workers:
             return self._step_local()
         sampled = 0
@@ -180,6 +255,52 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
             self.sample_tasks.add(worker, worker.sample.remote())
         return sampled
 
+    def _step_inline(self) -> dict:
+        """Inline-actor mode: actors run free on their own threads; one
+        optimizer step = at least one learner update drained."""
+        trained = 0
+        # First step compiles the inference + learner programs. Steady
+        # state still allows for slow host->device links (large fragments
+        # through a tunneled chip can take minutes per cycle).
+        timeout = 600.0 if not self._compiled else 180.0
+        deadline = time.monotonic() + timeout
+        while trained == 0 and time.monotonic() < deadline:
+            self._check_learner_alive()
+            try:
+                trained += self.learner.outqueue.get(timeout=1.0)
+            except queue.Empty:
+                continue
+        if trained == 0:
+            raise RuntimeError(
+                "inline actors produced no trained batch within "
+                f"{timeout}s (learner stalled?)")
+        self._compiled = True
+        while not self.learner.outqueue.empty():
+            trained += self.learner.outqueue.get()
+        sampled_total = sum(a.steps_sampled for a in self._inline_actors)
+        self.num_steps_sampled += sampled_total - self._inline_sampled_seen
+        self._inline_sampled_seen = sampled_total
+        self.num_steps_trained += trained
+        self.learner_stats = self.learner.stats
+        return self.learner_stats
+
+    def inline_episodes(self):
+        """Drain episode metrics from inline-actor samplers (merged into
+        trainer results by `Trainer._result_from_optimizer`)."""
+        out = []
+        for a in self._inline_actors:
+            out.extend(a.sampler.get_metrics())
+        return out
+
+    def _check_learner_alive(self):
+        """Fail fast with the real cause when the learner thread died
+        (its step has no recovery path: any loss/device error kills it)."""
+        if self.learner.error is not None:
+            raise RuntimeError(
+                "learner thread died") from self.learner.error
+        if not self.learner.is_alive() and not self.learner.stopped:
+            raise RuntimeError("learner thread exited unexpectedly")
+
     def _step_local(self) -> dict:
         """Degenerate num_workers=0 mode: sample locally, learn inline."""
         batches = []
@@ -192,7 +313,16 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         self.learner.inqueue.put(train_batch)
         # Generous timeout: the first update includes XLA compilation,
         # which can take minutes for large programs.
-        trained = self.learner.outqueue.get(timeout=600.0)
+        deadline = time.monotonic() + 600.0
+        trained = None
+        while trained is None:
+            self._check_learner_alive()
+            try:
+                trained = self.learner.outqueue.get(timeout=1.0)
+            except queue.Empty:
+                if time.monotonic() >= deadline:
+                    raise RuntimeError(
+                        "learner produced no result within 600s")
         self.num_steps_sampled += count
         self.num_steps_trained += trained
         self.learner_stats = self.learner.stats
@@ -213,7 +343,11 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         return out
 
     def stop(self):
+        for a in self._inline_actors:
+            a.stop()
         self.learner.stop()
+        for a in self._inline_actors:
+            a.join(timeout=5.0)
         self.learner.join(timeout=5.0)
 
 

@@ -40,8 +40,8 @@ from .policy import Policy
 _DEVICE_COLUMNS = (
     sb.OBS, sb.NEW_OBS, sb.ACTIONS, sb.REWARDS, sb.DONES, sb.ACTION_LOGP,
     sb.ACTION_DIST_INPUTS, sb.VF_PREDS, sb.ADVANTAGES, sb.VALUE_TARGETS,
-    sb.PREV_ACTIONS, sb.PREV_REWARDS, "weights", "seq_mask",
-    "state_in_c", "state_in_h",
+    sb.PREV_ACTIONS, sb.PREV_REWARDS, sb.BOOTSTRAP_OBS, "weights",
+    "seq_mask", "state_in_c", "state_in_h",
 )
 
 
@@ -364,7 +364,23 @@ class JaxPolicy(Policy):
         # the reference's tower loader truncation, multi_gpu_impl.py:116).
         num_mb = max(1, n // minibatch_size)
         usable = num_mb * minibatch_size
-        dev_batch = self._device_batch(batch.slice(0, usable))
+        if sb.BOOTSTRAP_OBS in batch:
+            boot = np.asarray(batch[sb.BOOTSTRAP_OBS])
+            if seq_len <= 1 or len(boot) * seq_len != n:
+                raise ValueError(
+                    f"BOOTSTRAP_OBS has {len(boot)} fragments but the "
+                    f"batch has {n} rows at seq_len={seq_len}; packed "
+                    "fragment batches must run with seq_len == "
+                    "rollout_fragment_length")
+            if usable != n:
+                # Row truncation at fragment granularity: keep the
+                # matching bootstrap rows (slice() drops the column).
+                sliced = batch.slice(0, usable)
+                sliced[sb.BOOTSTRAP_OBS] = boot[:usable // seq_len]
+                batch = sliced
+        elif usable != n:
+            batch = batch.slice(0, usable)
+        dev_batch = self._device_batch(batch)
         key = (num_sgd_iter, num_mb, minibatch_size, seq_len)
         if key not in self._sgd_fns:
             self._sgd_fns[key] = self._make_sgd_fn(*key)
@@ -389,10 +405,18 @@ class JaxPolicy(Policy):
                 perm = jax.random.permutation(erng, num_seq)
                 idx = (perm[:, None] * seq_len
                        + jnp.arange(seq_len)[None, :]).reshape(-1)
-                shuffled = jax.tree.map(lambda x: x[idx], batch)
+                # BOOTSTRAP_OBS is fragment-indexed ([num_seq, ...]):
+                # it follows the sequence permutation, not the row index.
+                row_batch = {k: v for k, v in batch.items()
+                             if k != sb.BOOTSTRAP_OBS}
+                shuffled = jax.tree.map(lambda x: x[idx], row_batch)
                 mbs = jax.tree.map(
                     lambda x: x.reshape((num_mb, mb_size) + x.shape[1:]),
                     shuffled)
+                if sb.BOOTSTRAP_OBS in batch:
+                    boot = batch[sb.BOOTSTRAP_OBS][perm]
+                    mbs[sb.BOOTSTRAP_OBS] = boot.reshape(
+                        (num_mb, mb_size // seq_len) + boot.shape[1:])
 
                 def mb_step(carry, mb):
                     params, opt_state = carry

@@ -34,6 +34,13 @@ UNROLL_ID = "unroll_id"
 SEQ_LENS = "seq_lens"
 STATE_IN = "state_in"
 STATE_OUT = "state_out"
+# Per-fragment bootstrap observation, shape [num_fragments, ...] — one
+# row per rollout fragment rather than per step (emitted by the packed
+# VectorSampler so the learner never ships a full NEW_OBS column).
+BOOTSTRAP_OBS = "bootstrap_obs"
+
+# Columns whose leading dimension is NOT the per-step row count.
+_NON_ROW_COLUMNS = (SEQ_LENS, BOOTSTRAP_OBS)
 
 
 class SampleBatch(dict):
@@ -41,14 +48,15 @@ class SampleBatch(dict):
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        lens = {k: len(v) for k, v in self.items() if k != SEQ_LENS}
+        lens = {k: len(v) for k, v in self.items()
+                if k not in _NON_ROW_COLUMNS}
         if lens and len(set(lens.values())) > 1:
             raise ValueError(f"column lengths differ: {lens}")
 
     @property
     def count(self) -> int:
         for k, v in self.items():
-            if k != SEQ_LENS:
+            if k not in _NON_ROW_COLUMNS:
                 return len(v)
         return 0
 
@@ -77,14 +85,17 @@ class SampleBatch(dict):
     # -- access ----------------------------------------------------------
     def rows(self) -> Iterator[dict]:
         for i in range(self.count):
-            yield {k: v[i] for k, v in self.items() if k != SEQ_LENS}
+            yield {k: v[i] for k, v in self.items()
+                   if k not in _NON_ROW_COLUMNS}
 
     def columns(self, keys: List[str]) -> List:
         return [self[k] for k in keys]
 
     def slice(self, start: int, end: int) -> "SampleBatch":
+        # Row slicing drops fragment-indexed columns (BOOTSTRAP_OBS):
+        # they no longer align once rows are cut.
         return SampleBatch({k: v[start:end] for k, v in self.items()
-                            if k != SEQ_LENS})
+                            if k not in _NON_ROW_COLUMNS})
 
     def shuffle(self, rng: np.random.Generator = None) -> "SampleBatch":
         rng = rng or np.random.default_rng()
@@ -92,7 +103,7 @@ class SampleBatch(dict):
         return SampleBatch({
             k: (v[perm] if isinstance(v, np.ndarray)
                 else [v[i] for i in perm])
-            for k, v in self.items() if k != SEQ_LENS})
+            for k, v in self.items() if k not in _NON_ROW_COLUMNS})
 
     def split_by_episode(self) -> List["SampleBatch"]:
         if EPS_ID not in self:

@@ -1,0 +1,380 @@
+"""Tests for the TPU-native rollout architectures added in round 3:
+
+- BatchedEnv (vectorized host envs) — the Sebulba actor's unit of work
+- VectorSampler — packed O(1)-python-per-step sampling
+- Inline actors (Sebulba) — batched learner-device inference
+- JaxEnv + AnakinOptimizer — fully device-resident IMPALA
+
+Reference test model (SURVEY.md §4): regression-by-learning for the
+end-to-end paths, numeric parity for env dynamics.
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib import sample_batch as sb
+from ray_tpu.rllib.env.batched_env import (BatchedCartPole,
+                                           BatchedEnvFromSingle,
+                                           BatchedSyntheticAtari)
+from ray_tpu.rllib.env.env import CartPole, Pendulum
+from ray_tpu.rllib.env.registry import make_batched_env
+from ray_tpu.rllib.sample_batch import SampleBatch
+
+
+@pytest.fixture
+def ray_session():
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+# ---------------------------------------------------------------------
+# BatchedEnv
+# ---------------------------------------------------------------------
+class TestBatchedEnvs:
+    def test_batched_cartpole_matches_single_dynamics(self):
+        single = CartPole()
+        single.seed(0)
+        single.reset()
+        batched = BatchedCartPole(3, seed=0)
+        batched.vector_reset()
+        # Inject identical state, step with identical actions, compare.
+        state = np.array([0.01, -0.02, 0.03, 0.04])
+        single._state = state.copy()
+        single._t = 0
+        batched._state = np.tile(state, (3, 1))
+        batched._t[:] = 0
+        for action in [0, 1, 1, 0, 1]:
+            obs_s, r_s, d_s, _ = single.step(action)
+            obs_b, r_b, d_b = batched.vector_step(np.full(3, action))
+            np.testing.assert_allclose(obs_b[1], obs_s, rtol=1e-6)
+            assert r_b[1] == r_s
+            assert bool(d_b[1]) == d_s
+            if d_s:
+                break
+
+    def test_batched_cartpole_auto_resets(self):
+        env = BatchedCartPole(4, max_steps=5, seed=0)
+        env.vector_reset()
+        done_seen = False
+        for _ in range(6):
+            obs, rew, dones = env.vector_step(np.ones(4, np.int64))
+            done_seen = done_seen or dones.any()
+        assert done_seen
+        # After auto-reset the step counters restarted.
+        assert (env._t < 5).all()
+
+    def test_batched_synthetic_atari_signal(self):
+        env = BatchedSyntheticAtari(8, episode_len=50, seed=0)
+        obs = env.vector_reset()
+        assert obs.shape == (8, 84, 84, 4) and obs.dtype == np.uint8
+        # Playing the target action yields reward 1 for every slot.
+        obs, rew, dones = env.vector_step(env._target.copy())
+        np.testing.assert_array_equal(rew, np.ones(8, np.float32))
+        # The bright band encodes the (new) target: band rows are brighter.
+        band = 84 // env.num_actions
+        for i in range(8):
+            t = int(env._target[i])
+            band_mean = obs[i, t * band:(t + 1) * band].mean()
+            rest = np.concatenate(
+                [obs[i, :t * band], obs[i, (t + 1) * band:]])
+            assert band_mean > rest.mean() + 64
+
+    def test_batched_synthetic_atari_episode_len(self):
+        env = BatchedSyntheticAtari(2, episode_len=3, seed=0)
+        env.vector_reset()
+        dones = [env.vector_step(np.zeros(2, np.int64))[2] for _ in range(3)]
+        assert not dones[0].any() and not dones[1].any()
+        assert dones[2].all()
+
+    def test_fallback_adapter_and_registry(self):
+        env = make_batched_env("Pendulum-v0", 3, seed=0)
+        assert isinstance(env, BatchedEnvFromSingle)
+        obs = env.vector_reset()
+        assert obs.shape == (3, 3)
+        obs, rew, dones = env.vector_step(np.zeros((3, 1), np.float32))
+        assert obs.shape == (3, 3) and rew.shape == (3,)
+        # Natively-vectorized registration wins for CartPole.
+        env2 = make_batched_env("CartPole-v0", 2, seed=0)
+        assert isinstance(env2, BatchedCartPole)
+
+
+# ---------------------------------------------------------------------
+# SampleBatch BOOTSTRAP_OBS semantics
+# ---------------------------------------------------------------------
+class TestBootstrapObsColumn:
+    def test_count_ignores_fragment_columns(self):
+        b = SampleBatch({
+            sb.BOOTSTRAP_OBS: np.zeros((2, 4)),
+            sb.OBS: np.zeros((10, 4)),
+            sb.REWARDS: np.zeros(10),
+        })
+        assert b.count == 10
+
+    def test_concat_concatenates_bootstrap(self):
+        mk = lambda: SampleBatch({sb.OBS: np.zeros((6, 2)),
+                                  sb.BOOTSTRAP_OBS: np.zeros((2, 2))})
+        out = SampleBatch.concat_samples([mk(), mk()])
+        assert out[sb.OBS].shape == (12, 2)
+        assert out[sb.BOOTSTRAP_OBS].shape == (4, 2)
+
+    def test_slice_drops_bootstrap(self):
+        b = SampleBatch({sb.OBS: np.arange(12).reshape(6, 2),
+                         sb.BOOTSTRAP_OBS: np.zeros((2, 2))})
+        s = b.slice(0, 3)
+        assert sb.BOOTSTRAP_OBS not in s and s.count == 3
+
+
+# ---------------------------------------------------------------------
+# VectorSampler packing
+# ---------------------------------------------------------------------
+class _ScriptedPolicy:
+    """Deterministic policy: action = (step index) % 2, records calls."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def compute_actions(self, obs, state_batches=None, explore=True):
+        n = len(obs)
+        actions = np.full(n, self.calls % 2, np.int64)
+        self.calls += 1
+        extra = {sb.ACTION_LOGP: np.zeros(n, np.float32),
+                 sb.ACTION_DIST_INPUTS: np.zeros((n, 2), np.float32),
+                 sb.VF_PREDS: np.zeros(n, np.float32)}
+        return actions, [], extra
+
+
+class TestVectorSampler:
+    def test_packing_layout(self):
+        from ray_tpu.rllib.evaluation.vector_sampler import VectorSampler
+        env = BatchedCartPole(4, seed=0)
+        pol = _ScriptedPolicy()
+        sampler = VectorSampler(env, pol, rollout_fragment_length=10)
+        batch = sampler.sample()
+        assert batch.count == 40
+        assert batch[sb.OBS].shape == (40, 4)
+        assert batch[sb.BOOTSTRAP_OBS].shape == (4, 4)
+        # Env-major: each env's 10 rows are contiguous, t restarts per
+        # env (no dones expected in 10 steps from near-zero init).
+        t = batch[sb.T].reshape(4, 10)
+        for i in range(4):
+            deltas = np.diff(t[i])
+            assert ((deltas == 1) | (t[i][1:] == 0)).all()
+        # One compute_actions per step, not per env.
+        assert pol.calls == 10
+        # Bootstrap obs is the env's current obs after the fragment.
+        np.testing.assert_array_equal(batch[sb.BOOTSTRAP_OBS],
+                                      sampler._obs)
+
+    def test_eps_ids_change_at_dones(self):
+        from ray_tpu.rllib.evaluation.vector_sampler import VectorSampler
+        env = BatchedSyntheticAtari(2, episode_len=4, seed=0)
+        pol = _ScriptedPolicy()
+        sampler = VectorSampler(env, pol, rollout_fragment_length=10)
+        batch = sampler.sample()
+        eps = batch[sb.EPS_ID].reshape(2, 10)
+        dones = batch[sb.DONES].reshape(2, 10)
+        for i in range(2):
+            for step in range(9):
+                if dones[i, step]:
+                    assert eps[i, step + 1] != eps[i, step]
+                else:
+                    assert eps[i, step + 1] == eps[i, step]
+        assert len(sampler.metrics) == 4  # 2 envs x 2 completed episodes
+
+
+# ---------------------------------------------------------------------
+# End-to-end learning (regression-by-learning, SURVEY §4.2 lesson 2)
+# ---------------------------------------------------------------------
+class TestEndToEnd:
+    def test_inline_sebulba_impala_learns_cartpole(self, ray_session):
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        t = get_trainer_class("IMPALA")(config={
+            "env": "CartPole-v0",
+            "num_workers": 0,
+            "num_inline_actors": 1,
+            "num_envs_per_worker": 16,
+            "rollout_fragment_length": 20,
+            "train_batch_size": 320,
+            "lr": 3e-3,
+            "min_iter_time_s": 0,
+            "seed": 0,
+        })
+        best = 0.0
+        for _ in range(25):
+            r = t.train()
+            rew = r.get("episode_reward_mean")
+            if rew == rew:  # not nan
+                best = max(best, rew)
+            if best > 60:
+                break
+        t.stop()
+        assert best > 60, f"inline IMPALA failed to learn: best={best}"
+
+    def test_anakin_impala_learns_cartpole(self, ray_session):
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        t = get_trainer_class("IMPALA")(config={
+            "env": "CartPole-v0",
+            "anakin": True,
+            "num_workers": 0,
+            "num_envs_per_worker": 32,
+            "rollout_fragment_length": 20,
+            "train_batch_size": 640,
+            "num_tpus_for_learner": 4,
+            "lr": 3e-3,
+            "min_iter_time_s": 0,
+            "seed": 0,
+        })
+        best = 0.0
+        for _ in range(10):
+            r = t.train()
+            rew = r.get("episode_reward_mean", float("nan"))
+            if rew == rew:
+                best = max(best, rew)
+            if best > 150:
+                break
+        t.stop()
+        assert best > 150, f"anakin IMPALA failed to learn: best={best}"
+        # Throughput accounting matches the fused shape.
+        assert r["timesteps_this_iter"] == 32 * 20 * 10
+
+    def test_inline_appo_trains(self, ray_session):
+        """APPO shares the optimizer factory; its loss must accept
+        BOOTSTRAP_OBS fragment batches too (round-3 review finding)."""
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        t = get_trainer_class("APPO")(config={
+            "env": "CartPole-v0",
+            "num_workers": 0,
+            "num_inline_actors": 1,
+            "num_envs_per_worker": 8,
+            "rollout_fragment_length": 10,
+            "train_batch_size": 80,
+            "min_iter_time_s": 0,
+            "seed": 0,
+        })
+        r = t.train()
+        assert r["timesteps_this_iter"] > 0
+        t.stop()
+
+    def test_inline_impala_with_sgd_minibatches(self, ray_session):
+        """Minibatch SGD over fragment batches: BOOTSTRAP_OBS must follow
+        the sequence permutation inside the fused program."""
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        t = get_trainer_class("IMPALA")(config={
+            "env": "CartPole-v0",
+            "num_workers": 0,
+            "num_inline_actors": 1,
+            "num_envs_per_worker": 8,
+            "rollout_fragment_length": 10,
+            "train_batch_size": 80,
+            "num_sgd_iter": 2,
+            "sgd_minibatch_size": 40,
+            "min_iter_time_s": 0,
+            "seed": 0,
+        })
+        r = t.train()
+        assert r["timesteps_this_iter"] > 0
+        t.stop()
+
+    def test_learner_death_fails_fast(self, ray_session):
+        """A dead learner thread surfaces its real error immediately,
+        not a 600s stall (round-3 review finding)."""
+        import time
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        t = get_trainer_class("IMPALA")(config={
+            "env": "CartPole-v0",
+            "num_workers": 0,
+            "num_inline_actors": 1,
+            "num_envs_per_worker": 8,
+            "rollout_fragment_length": 10,
+            "train_batch_size": 80,
+            "min_iter_time_s": 0,
+            "seed": 0,
+        })
+        t.train()  # healthy first step
+        # Sabotage the next learner step.
+        def boom(*a, **k):
+            raise RuntimeError("injected learner failure")
+        t.optimizer.learner.local_worker.policy.learn_on_batch = boom
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="learner thread died"):
+            for _ in range(50):
+                t.optimizer.step()
+        assert time.monotonic() - t0 < 120
+        t.stop()
+
+    def test_inline_rejects_remote_workers(self, ray_session):
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        with pytest.raises(ValueError, match="alternative sampling"):
+            get_trainer_class("IMPALA")(config={
+                "env": "CartPole-v0",
+                "num_workers": 2,
+                "num_inline_actors": 1,
+                "rollout_fragment_length": 10,
+                "train_batch_size": 80,
+            })
+
+    def test_anakin_rejects_host_only_env(self, ray_session):
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        with pytest.raises(ValueError, match="no JAX"):
+            get_trainer_class("IMPALA")(config={
+                "env": "Pendulum-v0",
+                "anakin": True,
+                "num_workers": 0,
+                "num_envs_per_worker": 4,
+                "rollout_fragment_length": 5,
+                "train_batch_size": 20,
+                "seed": 0,
+            })
+
+    def test_anakin_rejects_workers(self, ray_session):
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        with pytest.raises(ValueError, match="num_workers"):
+            get_trainer_class("IMPALA")(config={
+                "env": "CartPole-v0",
+                "anakin": True,
+                "num_workers": 2,
+                "rollout_fragment_length": 5,
+                "train_batch_size": 20,
+            })
+
+
+# ---------------------------------------------------------------------
+# JaxEnv parity
+# ---------------------------------------------------------------------
+class TestJaxEnvs:
+    def test_jax_cartpole_matches_host_dynamics(self):
+        import jax
+        from ray_tpu.rllib.env.jax_env import JaxCartPole
+        env = JaxCartPole()
+        host = CartPole()
+        host.seed(0)
+        host.reset()
+        state0 = np.array([0.01, -0.02, 0.03, 0.04], np.float32)
+        host._state = state0.copy().astype(np.float64)
+        host._t = 0
+        jstate = {"s": state0, "t": np.int32(0)}
+        rng = jax.random.PRNGKey(0)
+        for action in [1, 0, 1, 1]:
+            obs_h, r_h, d_h, _ = host.step(action)
+            jstate, obs_j, r_j, d_j = env.step(jstate, action, rng)
+            np.testing.assert_allclose(np.asarray(obs_j), obs_h, rtol=1e-5)
+            assert float(r_j) == r_h and bool(d_j) == d_h
+
+    def test_jax_synthetic_atari_contract(self):
+        import jax
+        from ray_tpu.rllib.env.jax_env import JaxSyntheticAtari
+        env = JaxSyntheticAtari(episode_len=3)
+        state, obs = env.reset(jax.random.PRNGKey(0))
+        obs = np.asarray(obs)
+        assert obs.shape == (84, 84, 4) and obs.dtype == np.uint8
+        # Correct action is rewarded.
+        state2, _, r, d = env.step(state, int(state["target"]),
+                                   jax.random.PRNGKey(1))
+        assert float(r) == 1.0 and not bool(d)
+        # Episode terminates after episode_len steps.
+        s = state
+        for k in range(3):
+            s, _, _, d = env.step(s, 0, jax.random.PRNGKey(k + 2))
+        assert bool(d)
