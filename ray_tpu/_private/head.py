@@ -95,6 +95,7 @@ class NodeInfo:
         self.idle: deque = deque()  # addrs of idle pool workers
         self.spawning_pool = 0  # pool workers requested but unregistered
         self.alive = True
+        self.last_heartbeat = time.monotonic()
 
     def fits(self, resources: Dict[str, float]) -> bool:
         return all(self.available.get(k, 0.0) + 1e-9 >= v
@@ -140,6 +141,13 @@ class HeadServer:
         self._token_counter = 0
         self._unregistered_deaths = 0
         self._profile_events: List[dict] = []
+        # Deadline-driven node liveness (reference: 100 ms heartbeats x
+        # num_heartbeats_timeout=300, `ray_config_def.h:24,28` +
+        # `raylet/monitor.cc`): agents heartbeat into the head; a node
+        # whose beats stop — even with a live TCP connection (wedged
+        # process, SIGSTOP) — is declared dead after the timeout.
+        self._heartbeat_timeout = float(
+            os.environ.get("RAY_TPU_HEARTBEAT_TIMEOUT_S", "30"))
 
         self.server = protocol.Server(
             self.sock_path, self._handle, on_connect=self._on_connect,
@@ -253,6 +261,12 @@ class HeadServer:
 
     def _h_publish(self, conn, msg):
         self._publish(msg["channel"], msg["data"])
+
+    def _h_heartbeat(self, conn, msg):
+        with self._lock:
+            node = self._nodes.get(msg["node_id"])
+            if node is not None:
+                node.last_heartbeat = time.monotonic()
 
     def _publish(self, channel: str, data):
         with self._lock:
@@ -641,6 +655,8 @@ class HeadServer:
         while not self._shutdown:
             time.sleep(0.05)
             dead: List[WorkerInfo] = []
+            stale_nodes: List[NodeInfo] = []
+            now = time.monotonic()
             with self._lock:
                 for w in self._spawned.values():
                     if w.proc is not None and w.proc.poll() is not None \
@@ -648,8 +664,28 @@ class HeadServer:
                         w._reaped = True
                         w.returncode = w.proc.returncode
                         dead.append(w)
+                for node in self._nodes.values():
+                    # Agent-backed nodes only: node0 is this process.
+                    if (node.conn is not None and node.alive
+                            and now - node.last_heartbeat
+                            > self._heartbeat_timeout):
+                        stale_nodes.append(node)
             for w in dead:
                 self._handle_worker_death(w)
+            for node in stale_nodes:
+                self._publish("error", (
+                    f"node {node.node_id} missed heartbeats for "
+                    f"{self._heartbeat_timeout:g}s; declaring it dead"))
+                logger.warning("node %s heartbeat timeout", node.node_id)
+                # Closing the connection routes through the normal
+                # node-death path (_on_conn_close -> _handle_node_death):
+                # workers declared dead, tasks rescheduled, callers
+                # unblocked with errors.
+                try:
+                    node.conn.close()
+                except Exception:
+                    pass
+                self._handle_node_death(node.node_id)
 
     def _handle_worker_death(self, w: WorkerInfo, node_death: bool = False):
         failed_boot = False
@@ -728,6 +764,19 @@ class HeadServer:
             del self._nodes[node_id]
         self._publish("error", f"node {node_id} died")
         for w in victims:
+            # A dead node's workers are dead with it (machine-loss
+            # semantics). When the node was declared dead by heartbeat
+            # timeout the worker processes may still be running — order
+            # them to exit so a zombie node can't keep pushing results.
+            if w.conn is not None:
+                try:
+                    w.conn.send({"kind": "shutdown"})
+                except protocol.ConnectionClosed:
+                    pass
+                try:
+                    w.conn.close()
+                except Exception:
+                    pass
             self._handle_worker_death(w, node_death=True)
 
     def _handle_actor_death(self, actor_id: ActorID, w: WorkerInfo):

@@ -104,8 +104,23 @@ class NodeAgent:
 
     # ------------------------------------------------------------------
     def _monitor_loop(self):
+        # Heartbeats let the head detect a wedged (not just disconnected)
+        # node: a SIGSTOPped agent keeps its TCP socket open but stops
+        # beating (reference: raylet_heartbeat_timeout_milliseconds,
+        # `ray_config_def.h:24`).
+        hb_interval = float(os.environ.get(
+            "RAY_TPU_HEARTBEAT_INTERVAL_S", "0.5"))
+        last_hb = 0.0
         while not self._shutdown.is_set():
             time.sleep(0.05)
+            now = time.monotonic()
+            if now - last_hb >= hb_interval:
+                last_hb = now
+                try:
+                    self.head.send({"kind": "heartbeat",
+                                    "node_id": self.node_id})
+                except protocol.ConnectionClosed:
+                    return
             dead = []
             with self._lock:
                 for token, proc in list(self._procs.items()):

@@ -8,9 +8,13 @@ object store (Podracer/Sebulba split).
 
 from __future__ import annotations
 
+import logging
+
 from ...optimizers.async_samples_optimizer import AsyncSamplesOptimizer
 from ..trainer_template import build_trainer
 from .vtrace_policy import DEFAULT_CONFIG, VTraceJaxPolicy
+
+logger = logging.getLogger(__name__)
 
 
 def make_async_optimizer(workers, config):
@@ -62,6 +66,18 @@ def validate_config(config):
         # keeps a single probe env (spaces only).
         config["_inline_num_envs"] = config.get("num_envs_per_worker", 1)
         config["num_envs_per_worker"] = 1
+        # One actor fragment IS the train batch in this mode; align the
+        # config key so downstream consumers (and users reading results)
+        # see the effective value instead of a silently-ignored one.
+        effective = config["_inline_num_envs"] \
+            * config["rollout_fragment_length"]
+        if config.get("train_batch_size") not in (None, effective):
+            logger.info(
+                "inline-actor mode trains on whole %d-step fragments "
+                "(num_envs_per_worker * rollout_fragment_length); "
+                "overriding train_batch_size=%s",
+                effective, config.get("train_batch_size"))
+        config["train_batch_size"] = effective
     if config.get("anakin"):
         if config.get("num_workers"):
             raise ValueError(
@@ -75,6 +91,15 @@ def validate_config(config):
         # RolloutWorker keeps a single probe env (spaces only).
         config["_anakin_num_envs"] = config.get("num_envs_per_worker", 1)
         config["num_envs_per_worker"] = 1
+        # Each fused update trains on one num_envs x T fragment batch.
+        effective = config["_anakin_num_envs"] \
+            * config["rollout_fragment_length"]
+        if config.get("train_batch_size") not in (None, effective):
+            logger.info(
+                "anakin mode trains on whole %d-step fragment batches; "
+                "overriding train_batch_size=%s",
+                effective, config.get("train_batch_size"))
+        config["train_batch_size"] = effective
     if (config.get("model") or {}).get("use_lstm"):
         # Recurrent IMPALA trains on the packed fragments themselves:
         # one fragment = one LSTM sequence.

@@ -148,7 +148,14 @@ def _appo_gae_loss(policy, params, batch, rng, loss_state):
 def appo_validate_config(config):
     if not config.get("vtrace", True):
         # GAE mode: episode-chunked sampling with worker-side advantage
-        # computation instead of packed fragments.
+        # computation instead of packed fragments. The GAE loss reads
+        # ADVANTAGES columns that neither the VectorSampler nor the
+        # fused anakin rollout produces.
+        if config.get("anakin") or config.get("num_inline_actors"):
+            raise ValueError(
+                "APPO with vtrace=False (GAE mode) requires remote "
+                "rollout workers; anakin / num_inline_actors only "
+                "support the V-trace fragment path")
         config["pack_fragments"] = False
         config["use_gae"] = True
         return

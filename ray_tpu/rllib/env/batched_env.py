@@ -155,26 +155,13 @@ class BatchedSyntheticAtari(BatchedEnv):
 
 
 class BatchedCartPole(BatchedEnv):
-    """Vectorized CartPole with the same dynamics/termination as
-    `env.py:CartPole` (gym CartPole-v0 semantics)."""
+    """Vectorized CartPole — dynamics shared with `env.py:CartPole` via
+    `cartpole_step` (gym CartPole-v0 semantics)."""
 
     def __init__(self, num_envs: int, max_steps: int = 200, seed=None):
+        from .env import init_cartpole_constants
+        init_cartpole_constants(self, max_steps)
         self.num_envs = num_envs
-        self.max_steps = max_steps
-        self.gravity = 9.8
-        self.masscart, self.masspole = 1.0, 0.1
-        self.total_mass = self.masscart + self.masspole
-        self.length = 0.5
-        self.polemass_length = self.masspole * self.length
-        self.force_mag = 10.0
-        self.tau = 0.02
-        self.theta_threshold = 12 * 2 * np.pi / 360
-        self.x_threshold = 2.4
-        high = np.array([self.x_threshold * 2, np.finfo(np.float32).max,
-                         self.theta_threshold * 2, np.finfo(np.float32).max],
-                        dtype=np.float32)
-        self.observation_space = Box(-high, high)
-        self.action_space = Discrete(2)
         self._rng = np.random.default_rng(seed)
         self._state = np.zeros((num_envs, 4))
         self._t = np.zeros(num_envs, np.int64)
@@ -189,26 +176,10 @@ class BatchedCartPole(BatchedEnv):
         return self._state.astype(np.float32)
 
     def vector_step(self, actions):
-        x, x_dot, theta, theta_dot = self._state.T
-        force = np.where(np.asarray(actions) == 1,
-                         self.force_mag, -self.force_mag)
-        costheta, sintheta = np.cos(theta), np.sin(theta)
-        temp = (force + self.polemass_length * theta_dot ** 2 * sintheta) \
-            / self.total_mass
-        thetaacc = (self.gravity * sintheta - costheta * temp) / (
-            self.length * (4.0 / 3.0
-                           - self.masspole * costheta ** 2 / self.total_mass))
-        xacc = temp - self.polemass_length * thetaacc * costheta \
-            / self.total_mass
-        x = x + self.tau * x_dot
-        x_dot = x_dot + self.tau * xacc
-        theta = theta + self.tau * theta_dot
-        theta_dot = theta_dot + self.tau * thetaacc
-        self._state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+        from .env import cartpole_step
+        self._state, violation = cartpole_step(self, self._state, actions)
         self._t += 1
-        dones = ((np.abs(x) > self.x_threshold)
-                 | (np.abs(theta) > self.theta_threshold)
-                 | (self._t >= self.max_steps))
+        dones = violation | (self._t >= self.max_steps)
         rewards = np.ones(self.num_envs, np.float32)
         if dones.any():
             self._reset_rows(dones)

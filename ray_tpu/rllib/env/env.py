@@ -34,27 +34,56 @@ class Env:
         pass
 
 
+def init_cartpole_constants(obj, max_steps: int):
+    """Shared CartPole parameters + spaces (Barto-Sutton-Anderson '83,
+    gym CartPole-v0 values). One definition serves the single-env,
+    batched-numpy, and (by numeric parity test) JAX implementations."""
+    obj.gravity = 9.8
+    obj.masscart, obj.masspole = 1.0, 0.1
+    obj.total_mass = obj.masscart + obj.masspole
+    obj.length = 0.5  # half pole length
+    obj.polemass_length = obj.masspole * obj.length
+    obj.force_mag = 10.0
+    obj.tau = 0.02
+    obj.theta_threshold = 12 * 2 * np.pi / 360
+    obj.x_threshold = 2.4
+    obj.max_steps = max_steps
+    high = np.array([obj.x_threshold * 2, np.finfo(np.float32).max,
+                     obj.theta_threshold * 2, np.finfo(np.float32).max],
+                    dtype=np.float32)
+    obj.observation_space = Box(-high, high)
+    obj.action_space = Discrete(2)
+
+
+def cartpole_step(p, state: np.ndarray, actions) -> tuple:
+    """Euler-integrate one step for a [N, 4] state batch. Returns
+    (new_state [N, 4], threshold_violation [N] bool). `p` carries the
+    constants from `init_cartpole_constants`."""
+    x, x_dot, theta, theta_dot = state.T
+    force = np.where(np.asarray(actions) == 1, p.force_mag, -p.force_mag)
+    costheta, sintheta = np.cos(theta), np.sin(theta)
+    temp = (force + p.polemass_length * theta_dot ** 2 * sintheta) \
+        / p.total_mass
+    thetaacc = (p.gravity * sintheta - costheta * temp) / (
+        p.length * (4.0 / 3.0
+                    - p.masspole * costheta ** 2 / p.total_mass))
+    xacc = temp - p.polemass_length * thetaacc * costheta / p.total_mass
+    x = x + p.tau * x_dot
+    x_dot = x_dot + p.tau * xacc
+    theta = theta + p.tau * theta_dot
+    theta_dot = theta_dot + p.tau * thetaacc
+    new_state = np.stack([x, x_dot, theta, theta_dot], axis=1)
+    violation = (np.abs(x) > p.x_threshold) \
+        | (np.abs(theta) > p.theta_threshold)
+    return new_state, violation
+
+
 class CartPole(Env):
-    """Cart-pole balancing (dynamics per Barto-Sutton-Anderson '83, matching
-    gym CartPole-v0: 200-step limit, +1 reward per step, terminate at
-    |x|>2.4 or |theta|>12deg)."""
+    """Cart-pole balancing (200-step limit, +1 reward per step, terminate
+    at |x|>2.4 or |theta|>12deg); dynamics shared with BatchedCartPole."""
 
     def __init__(self, max_steps: int = 200):
-        self.gravity = 9.8
-        self.masscart, self.masspole = 1.0, 0.1
-        self.total_mass = self.masscart + self.masspole
-        self.length = 0.5  # half pole length
-        self.polemass_length = self.masspole * self.length
-        self.force_mag = 10.0
-        self.tau = 0.02
-        self.theta_threshold = 12 * 2 * np.pi / 360
-        self.x_threshold = 2.4
-        self.max_steps = max_steps
-        high = np.array([self.x_threshold * 2, np.finfo(np.float32).max,
-                         self.theta_threshold * 2, np.finfo(np.float32).max],
-                        dtype=np.float32)
-        self.observation_space = Box(-high, high)
-        self.action_space = Discrete(2)
+        init_cartpole_constants(self, max_steps)
         self._rng = np.random.default_rng()
         self._state = None
         self._t = 0
@@ -65,24 +94,11 @@ class CartPole(Env):
         return self._state.astype(np.float32)
 
     def step(self, action):
-        x, x_dot, theta, theta_dot = self._state
-        force = self.force_mag if action == 1 else -self.force_mag
-        costheta, sintheta = np.cos(theta), np.sin(theta)
-        temp = (force + self.polemass_length * theta_dot ** 2 * sintheta) \
-            / self.total_mass
-        thetaacc = (self.gravity * sintheta - costheta * temp) / (
-            self.length * (4.0 / 3.0
-                           - self.masspole * costheta ** 2 / self.total_mass))
-        xacc = temp - self.polemass_length * thetaacc * costheta / self.total_mass
-        x += self.tau * x_dot
-        x_dot += self.tau * xacc
-        theta += self.tau * theta_dot
-        theta_dot += self.tau * thetaacc
-        self._state = np.array([x, x_dot, theta, theta_dot])
+        new_state, violation = cartpole_step(
+            self, self._state[None, :], np.array([action]))
+        self._state = new_state[0]
         self._t += 1
-        done = bool(abs(x) > self.x_threshold
-                    or abs(theta) > self.theta_threshold
-                    or self._t >= self.max_steps)
+        done = bool(violation[0]) or self._t >= self.max_steps
         return self._state.astype(np.float32), 1.0, done, {}
 
 

@@ -112,18 +112,24 @@ class InlineActorThread(threading.Thread):
         self.sampler = sampler
         self.learner = learner
         self.stopped = False
+        self.error = None  # first exception that killed the thread
         self.steps_sampled = 0  # monotonic; read without lock (int swap)
 
     def run(self):
-        while not self.stopped:
-            batch = self.sampler.sample()
-            self.steps_sampled += batch.count
+        try:
             while not self.stopped:
-                try:
-                    self.learner.inqueue.put(batch, timeout=1.0)
-                    break
-                except queue.Full:
-                    continue
+                batch = self.sampler.sample()
+                self.steps_sampled += batch.count
+                while not self.stopped:
+                    try:
+                        self.learner.inqueue.put(batch, timeout=1.0)
+                        break
+                    except queue.Full:
+                        continue
+        except Exception as e:  # noqa: BLE001 — surfaced to driver
+            logger.exception("inline actor died")
+            self.error = e
+            self.stopped = True
 
     def stop(self):
         self.stopped = True
@@ -293,13 +299,17 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         return out
 
     def _check_learner_alive(self):
-        """Fail fast with the real cause when the learner thread died
-        (its step has no recovery path: any loss/device error kills it)."""
+        """Fail fast with the real cause when the learner thread or an
+        inline actor died (neither has a recovery path: any loss/device/
+        env error kills its thread)."""
         if self.learner.error is not None:
             raise RuntimeError(
                 "learner thread died") from self.learner.error
         if not self.learner.is_alive() and not self.learner.stopped:
             raise RuntimeError("learner thread exited unexpectedly")
+        for a in self._inline_actors:
+            if a.error is not None:
+                raise RuntimeError("inline actor died") from a.error
 
     def _step_local(self) -> dict:
         """Degenerate num_workers=0 mode: sample locally, learn inline."""
