@@ -653,6 +653,7 @@ class Runtime:
             while len(self._result_specs) > self._lineage_max:
                 old_tid, _ = self._result_specs.popitem(last=False)
                 self._reconstruct_budget.pop(old_tid, None)
+                self._freed_returns.pop(old_tid, None)
         self.head.send({"kind": "submit_task", "spec": spec})
         return [ObjectRef(oid, self.addr) for oid in spec.return_ids()]
 

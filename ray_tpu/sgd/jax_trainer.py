@@ -15,9 +15,14 @@ into two planes:
   and XLA inserts the gradient psum over ICI. With `num_replicas=0`
   everything runs in-process on the full mesh — this is the TPU-native
   replacement for DDP on a single machine.
-- **Inter-host**: runner actors exchange gradients through the object
-  store (driver-averaged, synchronous), standing in for DCN allreduce;
-  `jax.distributed`-backed multi-host pods plug in here.
+- **Inter-host**: two modes. Default: runner actors exchange gradients
+  through the object store (driver-averaged, synchronous). With
+  `use_jax_distributed=True`, the runners join ONE `jax.distributed`
+  world (`parallel/distributed.py`): every runner jits the same train
+  step over the GLOBAL mesh spanning all runners' devices, feeds its
+  process-local batch shard, and XLA inserts the cross-process gradient
+  all-reduce (DCN) — the true TPU-pod replacement for
+  `init_process_group` + DDP (`distributed_pytorch_runner.py:47,62`).
 """
 
 from __future__ import annotations
@@ -60,13 +65,25 @@ class JaxRunner:
         self.num_devices = num_devices
         self.epoch = 0
 
-    def setup(self, world_size: int = 1, world_rank: int = 0):
+    def setup(self, world_size: int = 1, world_rank: int = 0,
+              coordinator: Optional[str] = None):
         """Build model/opt/data; shard the dataset by rank (parity:
-        DistributedSampler in `distributed_pytorch_runner.py:62`)."""
+        DistributedSampler in `distributed_pytorch_runner.py:62`).
+
+        With `coordinator`, first join the jax.distributed world: the
+        mesh then spans every runner's devices and the jitted step's
+        gradient psum crosses processes (DCN)."""
         self.world_size = world_size
         self.world_rank = world_rank
-        self.mesh = mesh_lib.make_mesh(
-            num_devices=self.num_devices or None)
+        self.distributed = coordinator is not None
+        if self.distributed:
+            from ..parallel import distributed as dist
+            dist.initialize(coordinator, num_processes=world_size,
+                            process_id=world_rank)
+            self.mesh = dist.global_mesh()
+        else:
+            self.mesh = mesh_lib.make_mesh(
+                num_devices=self.num_devices or None)
         n_dev = self.mesh.devices.size
         self._repl = mesh_lib.replicated(self.mesh)
         self._bshard = mesh_lib.batch_sharded(self.mesh)
@@ -81,6 +98,7 @@ class JaxRunner:
         else:
             train_data, val_data = data, None
         # Shard rows rank::world_size (DistributedSampler semantics).
+        self._n_total = len(np.asarray(train_data[0]))
         self.train_x, self.train_y = [
             np.asarray(a)[self.world_rank::self.world_size]
             for a in train_data]
@@ -90,10 +108,18 @@ class JaxRunner:
 
         rng = jax.random.PRNGKey(self.config.get("seed", 0))
         dummy = self.train_x[:1]
-        self.params = mesh_lib.put_replicated(
-            self.model.init(rng, jnp.asarray(dummy)), self.mesh)
-        self.opt_state = mesh_lib.put_replicated(
-            self.optimizer.init(self.params), self.mesh)
+        host_params = self.model.init(rng, jnp.asarray(dummy))
+        if self.distributed:
+            # Same seed everywhere -> identical replicas; assembled as
+            # global replicated arrays over the multi-process mesh.
+            from ..parallel import distributed as dist
+            self.params = self._put_repl_global(host_params)
+            self.opt_state = self._put_repl_global(
+                self.optimizer.init(host_params))
+        else:
+            self.params = mesh_lib.put_replicated(host_params, self.mesh)
+            self.opt_state = mesh_lib.put_replicated(
+                self.optimizer.init(self.params), self.mesh)
 
         def train_step(params, opt_state, x, y):
             def batch_loss(p):
@@ -129,14 +155,42 @@ class JaxRunner:
             pred = self.model.apply(params, x)
             return self.loss_fn(pred, y)
 
-        self._eval_step = jax.jit(eval_step)
+        self._eval_step = jax.jit(
+            eval_step,
+            in_shardings=(self._repl, self._bshard, self._bshard),
+            out_shardings=self._repl)
         self._perm_rng = np.random.RandomState(
             self.config.get("seed", 0) + self.world_rank)
         return n_dev
 
+    def _put_repl_global(self, tree):
+        """Host tree -> fully-replicated global arrays on the
+        multi-process mesh (every process contributes its identical
+        copy)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+        sh = NamedSharding(self.mesh, PartitionSpec())
+        return jax.tree.map(
+            lambda a: jax.make_array_from_process_local_data(
+                sh, np.asarray(a)), tree)
+
     # -- local (intra-host) training -------------------------------------
     def _batches(self):
         n = len(self.train_x)
+        if self.distributed:
+            # Global batch split evenly across processes; the step count
+            # derives from the TOTAL length so every rank runs the same
+            # number of collective steps (SPMD lockstep — a rank with one
+            # extra local batch would deadlock the others).
+            per_global = mesh_lib.pad_to_multiple(
+                self.batch_size, self.mesh.devices.size)
+            per = per_global // self.world_size
+            n_min = self._n_total // self.world_size
+            idx = self._perm_rng.permutation(n)[:n_min]
+            for start in range(0, n_min - per + 1, per):
+                sel = idx[start:start + per]
+                yield self.train_x[sel], self.train_y[sel]
+            return
         per = mesh_lib.pad_to_multiple(
             self.batch_size, self.mesh.devices.size)
         idx = self._perm_rng.permutation(n)
@@ -151,13 +205,28 @@ class JaxRunner:
         t0 = time.time()
         count = 0
         for x, y in self._batches():
+            if self.distributed:
+                from ..parallel import distributed as dist
+                x = dist.process_local_batch(self._bshard, np.asarray(x))
+                y = dist.process_local_batch(self._bshard, np.asarray(y))
+            else:
+                x, y = jnp.asarray(x), jnp.asarray(y)
             self.params, self.opt_state, loss = self._train_step(
-                self.params, self.opt_state, jnp.asarray(x),
-                jnp.asarray(y))
-            losses.append(loss)
-            count += len(x)
+                self.params, self.opt_state, x, y)
+            if self.distributed:
+                # Scalar readback per step: replicated output, and a
+                # natural SPMD sync point. Count only this process's
+                # rows (x is the GLOBAL array here).
+                losses.append(float(loss))
+                count += x.shape[0] // self.world_size
+            else:
+                # Lazy device arrays: keep async dispatch pipelined;
+                # one reduction per epoch.
+                losses.append(loss)
+                count += len(x)
         self.epoch += 1
-        mean_loss = float(jnp.mean(jnp.stack(losses))) if losses else 0.0
+        mean_loss = float(np.mean([float(l) for l in losses])) \
+            if losses else 0.0
         return {"train_loss": mean_loss, "epoch": self.epoch,
                 "num_samples": count,
                 "time_s": round(time.time() - t0, 3)}
@@ -187,15 +256,39 @@ class JaxRunner:
         if self.val is None:
             return {}
         x, y = self.val
+        if self.distributed:
+            import jax
+            from ..parallel import distributed as dist
+            n_local_dev = len(jax.local_devices())
+            n_min = len(x) // self.world_size
+            n_keep = n_min - (n_min % max(1, n_local_dev))
+            if n_keep == 0:
+                return {}
+            sel = slice(self.world_rank, None, self.world_size)
+            x_loc = np.asarray(x)[sel][:n_keep]
+            y_loc = np.asarray(y)[sel][:n_keep]
+            loss = float(self._eval_step(
+                self.params,
+                dist.process_local_batch(self._bshard, x_loc),
+                dist.process_local_batch(self._bshard, y_loc)))
+            return {"validation_loss": loss}
+        # The sharded eval program needs rows to tile the mesh exactly.
+        n_keep = len(x) - len(x) % self.mesh.devices.size
+        if n_keep == 0:
+            return {}
         loss = float(self._eval_step(
-            self.params, jnp.asarray(x), jnp.asarray(y)))
+            self.params, jnp.asarray(np.asarray(x)[:n_keep]),
+            jnp.asarray(np.asarray(y)[:n_keep])))
         return {"validation_loss": loss}
 
     def get_weights(self):
         return jax.tree.map(np.asarray, self.params)
 
     def set_weights(self, weights):
-        self.params = mesh_lib.put_replicated(weights, self.mesh)
+        if getattr(self, "distributed", False):
+            self.params = self._put_repl_global(weights)
+        else:
+            self.params = mesh_lib.put_replicated(weights, self.mesh)
 
     def get_state(self) -> Dict:
         return {"params": self.get_weights(),
@@ -204,8 +297,11 @@ class JaxRunner:
 
     def set_state(self, state: Dict):
         self.set_weights(state["params"])
-        self.opt_state = mesh_lib.put_replicated(
-            jax.tree.map(jnp.asarray, state["opt_state"]), self.mesh)
+        if getattr(self, "distributed", False):
+            self.opt_state = self._put_repl_global(state["opt_state"])
+        else:
+            self.opt_state = mesh_lib.put_replicated(
+                jax.tree.map(jnp.asarray, state["opt_state"]), self.mesh)
         self.epoch = state["epoch"]
 
     def ping(self):
@@ -228,13 +324,25 @@ class JaxTrainer:
                  config: Optional[dict] = None,
                  num_replicas: int = 0,
                  batch_size: int = 64,
-                 num_devices_per_replica: int = 0):
+                 num_devices_per_replica: int = 0,
+                 use_jax_distributed: bool = False,
+                 runner_env: Optional[dict] = None):
         self._ctor_args = (model_creator, data_creator, optimizer_creator,
                            loss_creator)
         self.config = dict(config or {})
         self.batch_size = batch_size
         self.num_replicas = num_replicas
         self.num_devices_per_replica = num_devices_per_replica
+        # jax.distributed mode: runners form ONE global device world;
+        # gradient all-reduce happens inside XLA across processes (DCN)
+        # instead of through the object store.
+        self.use_jax_distributed = use_jax_distributed
+        self.runner_env = dict(runner_env or {})
+        if use_jax_distributed and num_replicas <= 0:
+            raise ValueError(
+                "use_jax_distributed needs num_replicas >= 1 runner "
+                "processes (in-process training already spans the local "
+                "mesh)")
         if num_replicas <= 0:
             self.local_runner = JaxRunner(
                 *self._ctor_args, config=self.config,
@@ -249,12 +357,21 @@ class JaxTrainer:
     def _start_runners(self, n: int):
         RemoteRunner = ray_tpu.remote(JaxRunner)
         self.runners = [
-            RemoteRunner.options(num_cpus=1).remote(
+            RemoteRunner.options(
+                num_cpus=1, env_vars=self.runner_env).remote(
                 *self._ctor_args, config=self.config,
                 batch_size=self.batch_size,
                 num_devices=self.num_devices_per_replica)
             for _ in range(n)]
-        ray_tpu.get([r.setup.remote(n, i)
+        coordinator = None
+        if self.use_jax_distributed:
+            # Coordinator lives in rank 0's process; the port is reserved
+            # on this host (single-host clusters / CI; a multi-host
+            # deployment passes the rank-0 host address via config).
+            from ..parallel import distributed as dist
+            coordinator = self.config.get("coordinator_address") \
+                or dist.reserve_coordinator_port()
+        ray_tpu.get([r.setup.remote(n, i, coordinator=coordinator)
                      for i, r in enumerate(self.runners)])
 
     # ------------------------------------------------------------------
@@ -279,7 +396,16 @@ class JaxTrainer:
         if self.local_runner is not None:
             return self.local_runner.train_epoch()
         stats = ray_tpu.get([r.train_epoch.remote() for r in self.runners])
-        self._average_weights()
+        if not self.use_jax_distributed:
+            # jax.distributed runners share gradients in-graph; their
+            # replicas are identical by construction.
+            self._average_weights()
+        else:
+            # A runner death wedges its peers inside a collective, so
+            # recovery cannot pull state from survivors (unlike the
+            # object-store mode): snapshot after each good epoch.
+            self._last_state = ray_tpu.get(
+                self.runners[0].get_state.remote())
         out = {k: float(np.mean([s[k] for s in stats]))
                for k in ("train_loss", "time_s")}
         out["epoch"] = int(max(s["epoch"] for s in stats))
@@ -294,6 +420,28 @@ class JaxTrainer:
         ray_tpu.get([r.set_weights.remote(ref) for r in self.runners])
 
     def _recover(self):
+        if self.use_jax_distributed:
+            # Survivors are wedged in a cross-process collective waiting
+            # on the dead peer — they can neither answer pings nor hand
+            # over state. Kill the whole fleet, rebuild one size smaller,
+            # restore from the last post-epoch snapshot.
+            n = max(1, len(self.runners) - 1)
+            for r in self.runners:
+                try:
+                    ray_tpu.kill(r)
+                except Exception:
+                    pass
+            self._start_runners(n)
+            state = getattr(self, "_last_state", None)
+            if state is not None:
+                ref = ray_tpu.put(state)
+                ray_tpu.get([r.set_state.remote(ref)
+                             for r in self.runners])
+            else:
+                logger.warning(
+                    "no snapshot yet; distributed fleet restarted from "
+                    "initial weights")
+            return
         alive = []
         for r in self.runners:
             try:
