@@ -81,6 +81,11 @@ class WorkerInfo:
         self.dedicated = False
         self.started_at = time.monotonic()
         self._reaped = False
+        # Worker lease (reference: `direct_task_transport.h:68,89` —
+        # steady-state task dispatch goes caller->worker directly; the
+        # head only grants/returns leases).
+        self.leased_to: Optional[str] = None  # caller addr
+        self.lease_resources: Optional[Dict[str, float]] = None
 
 
 class NodeInfo:
@@ -134,6 +139,8 @@ class HeadServer:
         self._spawned: Dict[str, WorkerInfo] = {}  # by token
         self._pending: deque = deque()  # TaskSpec queue
         self._inflight: Dict[TaskID, str] = {}  # task -> worker addr
+        # Unserved lease demand: [caller_addr, resources, remaining].
+        self._lease_queue: List[list] = []
         self._actors: Dict[ActorID, ActorInfo] = {}
         self._drivers: Set[protocol.Connection] = set()
         self._conns_by_addr: Dict[str, protocol.Connection] = {}
@@ -213,6 +220,7 @@ class HeadServer:
             self._drivers.discard(conn)
             for subs in self._subs.values():
                 subs.discard(conn)
+        self._release_leases_of(conn.peer_addr)
         if node_id is not None:
             self._handle_node_death(node_id)
 
@@ -280,8 +288,162 @@ class HeadServer:
     # -- tasks -----------------------------------------------------------
     def _h_submit_task(self, conn, msg):
         spec: TaskSpec = msg["spec"]
+        # Head-dispatched tasks must report task_done (a stale leased
+        # flag from a reconstruction resubmit would wedge the worker's
+        # accounting).
+        spec.leased = False
         with self._lock:
             self._pending.append(spec)
+            self._schedule_locked()
+
+    # -- worker leases (reference: `HandleRequestWorkerLease`,
+    # `node_manager.h:542`; caller-side pipelining lives in runtime.py) --
+    def _h_request_lease(self, conn, msg):
+        resources: Dict[str, float] = msg["resources"]
+        count: int = msg.get("count", 1)
+        granted: List[str] = []
+        with self._lock:
+            for _ in range(count):
+                addr = self._grant_lease_locked(conn.peer_addr, resources)
+                if addr is None:
+                    break
+                granted.append(addr)
+            remaining = count - len(granted)
+            if remaining > 0:
+                self._lease_queue.append(
+                    [conn.peer_addr, dict(resources), remaining])
+                self._grow_pool_for_leases_locked(resources, remaining)
+        if granted:
+            try:
+                conn.send({"kind": "lease_granted", "addrs": granted,
+                           "resources": resources})
+            except protocol.ConnectionClosed:
+                self._release_leases_of(conn.peer_addr)
+
+    def _grant_lease_locked(self, caller: str,
+                            resources: Dict[str, float]) -> Optional[str]:
+        for node in self._nodes.values():
+            if not node.alive or not node.idle or not node.fits(resources):
+                continue
+            addr = node.idle.popleft()
+            w = self._workers.get(addr)
+            if w is None:
+                continue
+            w.leased_to = caller
+            w.lease_resources = dict(resources)
+            node.acquire(resources)
+            return addr
+        return None
+
+    def _grow_pool_for_leases_locked(self, resources: Dict[str, float],
+                                     need: int):
+        """Spawn pool workers toward unserved lease demand (reference:
+        WorkerPool starts workers on lease requests). Growth per node is
+        capped at what its resource vector can actually lease
+        concurrently (counting workers already spawning), so demand
+        beyond one node's capacity spreads to the next — the lease-plane
+        equivalent of task spillback."""
+        for node in self._nodes.values():
+            if need <= 0:
+                break
+            if not node.alive:
+                continue
+            cap = self._lease_capacity(node, resources) \
+                - node.spawning_pool - len(node.idle)
+            for _ in range(min(need, max(0, cap))):
+                try:
+                    self._spawn_worker(node, dedicated=False)
+                except Exception:
+                    # One bad node must not block growth on the others.
+                    logger.exception("failed to grow pool on %s",
+                                     node.node_id)
+                    break
+                need -= 1
+
+    @staticmethod
+    def _lease_capacity(node: NodeInfo, resources: Dict[str, float]) -> int:
+        """How many `resources`-shaped leases the node's available
+        vector still fits."""
+        cap = 8  # zero-resource leases: bounded pool growth per node
+        for k, v in resources.items():
+            if v > 0:
+                cap = min(cap, int(node.available.get(k, 0.0) / v + 1e-9))
+        return cap
+
+    def _serve_lease_queue_locked(self):
+        still: List[list] = []
+        for req in self._lease_queue:
+            caller, resources, remaining = req
+            conn = self._conns_by_addr.get(caller)
+            if conn is None or conn.closed:
+                continue  # caller gone: drop its demand
+            addrs: List[str] = []
+            while remaining > 0:
+                addr = self._grant_lease_locked(caller, resources)
+                if addr is None:
+                    break
+                addrs.append(addr)
+                remaining -= 1
+            req[2] = remaining
+            if addrs:
+                try:
+                    conn.send({"kind": "lease_granted", "addrs": addrs,
+                               "resources": resources})
+                except protocol.ConnectionClosed:
+                    self._release_leases_of(caller)
+                    continue
+            if remaining > 0:
+                # Capacity may exist on OTHER nodes than the ones that
+                # served earlier demand: keep growing toward the deficit.
+                self._grow_pool_for_leases_locked(resources, remaining)
+                still.append(req)
+        self._lease_queue = still
+
+    def _h_cancel_lease_requests(self, conn, msg):
+        """Caller's backlog drained before its queued lease demand was
+        served: shrink/remove the stale entries."""
+        count = msg["count"]
+        resources = msg["resources"]
+        with self._lock:
+            kept = []
+            for req in self._lease_queue:
+                if count > 0 and req[0] == conn.peer_addr \
+                        and req[1] == resources:
+                    taken = min(count, req[2])
+                    req[2] -= taken
+                    count -= taken
+                if req[2] > 0:
+                    kept.append(req)
+            self._lease_queue = kept
+
+    def _h_return_lease(self, conn, msg):
+        with self._lock:
+            for addr in msg["addrs"]:
+                w = self._workers.get(addr)
+                if w is None or w.leased_to != conn.peer_addr:
+                    continue
+                node = self._nodes.get(w.node_id)
+                if node is not None:
+                    node.release(w.lease_resources or {})
+                    node.idle.append(addr)
+                w.leased_to = None
+                w.lease_resources = None
+            self._schedule_locked()
+
+    def _release_leases_of(self, caller: str):
+        """Caller process died/disconnected: its leased workers return to
+        the pool; its queued lease demand evaporates."""
+        with self._lock:
+            for w in self._workers.values():
+                if w.leased_to == caller:
+                    node = self._nodes.get(w.node_id)
+                    if node is not None:
+                        node.release(w.lease_resources or {})
+                        node.idle.append(w.addr)
+                    w.leased_to = None
+                    w.lease_resources = None
+            self._lease_queue = [r for r in self._lease_queue
+                                 if r[0] != caller]
             self._schedule_locked()
 
     def _h_task_done(self, conn, msg):
@@ -503,6 +665,11 @@ class HeadServer:
     def _schedule_locked(self):
         if self._shutdown:
             return
+        # Lease demand is served first: leased callers bypass this queue
+        # entirely in steady state, so keeping them fed maximizes the
+        # work that never touches the head again.
+        if self._lease_queue:
+            self._serve_lease_queue_locked()
         remaining = deque()
         # pool-worker deficit per node for runnable-but-unassigned tasks
         need_worker: Dict[str, int] = {}
@@ -689,6 +856,7 @@ class HeadServer:
 
     def _handle_worker_death(self, w: WorkerInfo, node_death: bool = False):
         failed_boot = False
+        lease_caller = None
         with self._lock:
             node = self._nodes.get(w.node_id)
             if w.addr is not None:
@@ -699,6 +867,12 @@ class HeadServer:
                         node.idle.remove(w.addr)
                     except ValueError:
                         pass
+                if w.leased_to is not None:
+                    if node is not None:
+                        node.release(w.lease_resources or {})
+                    lease_caller = w.leased_to
+                    w.leased_to = None
+                    w.lease_resources = None
             else:
                 if not w.dedicated and node is not None:
                     node.spawning_pool -= 1
@@ -709,6 +883,19 @@ class HeadServer:
                     # with it is NOT a boot loop.)
                     self._unregistered_deaths += 1
                     failed_boot = self._unregistered_deaths >= 3
+        if lease_caller is not None:
+            # Tell the lease holder explicitly: its direct connection to
+            # the worker may be half-open (hung node, partition) and
+            # would otherwise never error, leaving its in-flight leased
+            # tasks stuck.
+            with self._lock:
+                caller_conn = self._conns_by_addr.get(lease_caller)
+            if caller_conn is not None:
+                try:
+                    caller_conn.send({"kind": "leased_worker_died",
+                                      "worker_addr": w.addr})
+                except protocol.ConnectionClosed:
+                    pass
         if w.addr is None and not node_death:
             self._publish("error", (
                 f"worker pid={w.pid} exited (code {w.returncode}) "

