@@ -211,11 +211,20 @@ def cluster_info() -> dict:
     return _ws.get_runtime().cluster_info()
 
 
+def cluster_metrics() -> dict:
+    """Cluster-aggregated metric counters/gauges (parity: the
+    reference's Prometheus metrics plane, `src/ray/stats/`). Also
+    exposed via `ray_tpu stat --metrics` and, when RAY_TPU_METRICS_PORT
+    is set, as Prometheus text on http://127.0.0.1:<port>/metrics."""
+    return _ws.get_runtime().cluster_metrics()
+
+
 __all__ = [
     "ActorClass", "ActorDiedError", "ActorHandle", "GetTimeoutError",
     "ObjectLostError", "ObjectRef", "RayActorError", "RayError",
     "RayTaskError", "TaskError", "WorkerCrashedError", "available_resources",
-    "cluster_info", "cluster_resources", "exceptions", "exit_actor", "free",
+    "cluster_info", "cluster_metrics", "cluster_resources", "exceptions",
+    "exit_actor", "free",
     "get", "get_actor", "init", "is_initialized", "kill", "method",
     "profile", "put", "remote", "shutdown", "timeline", "wait",
 ]

@@ -155,6 +155,10 @@ class HeadServer:
         # process, SIGSTOP) — is declared dead after the timeout.
         self._heartbeat_timeout = float(
             os.environ.get("RAY_TPU_HEARTBEAT_TIMEOUT_S", "30"))
+        # Per-process metric snapshots pushed by workers/drivers
+        # (addr -> {"node":, "counters":, "gauges":}).
+        self._metric_snaps: Dict[str, dict] = {}
+        self._metrics_http = None
 
         self.server = protocol.Server(
             self.sock_path, self._handle, on_connect=self._on_connect,
@@ -171,6 +175,20 @@ class HeadServer:
         self._monitor_thread = threading.Thread(
             target=self._monitor_loop, daemon=True, name="head-monitor")
         self._monitor_thread.start()
+        # Worker-log tailing to the driver console (parity:
+        # `python/ray/log_monitor.py:36` -> `worker.py:910`). The head
+        # tails node0's log dir; node agents tail theirs.
+        if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+            from .log_tailer import LogTailer
+            self._log_tailer = LogTailer(
+                os.path.join(self.session_dir, "logs"), "node0",
+                publish=lambda data: self._publish("logs", data))
+            self._log_tailer.start()
+        # Prometheus exposition (reference: `src/ray/stats/metric.h`'s
+        # prometheus exposer, enabled in daemon mains).
+        port = int(os.environ.get("RAY_TPU_METRICS_PORT", "0") or 0)
+        if port:
+            self._start_metrics_http(port)
 
     # ------------------------------------------------------------------
     # connection lifecycle
@@ -218,6 +236,7 @@ class HeadServer:
         with self._lock:
             self._conns_by_addr.pop(conn.peer_addr, None)
             self._drivers.discard(conn)
+            self._metric_snaps.pop(conn.peer_addr, None)
             for subs in self._subs.values():
                 subs.discard(conn)
         self._release_leases_of(conn.peer_addr)
@@ -276,9 +295,79 @@ class HeadServer:
             if node is not None:
                 node.last_heartbeat = time.monotonic()
 
+    # -- metrics (reference: src/ray/stats/ + reporter.py) ---------------
+    def _h_metrics_push(self, conn, msg):
+        with self._lock:
+            self._metric_snaps[conn.peer_addr] = {
+                "node": msg.get("node", ""),
+                "counters": msg.get("counters") or {},
+                "gauges": msg.get("gauges") or {},
+            }
+
+    def _aggregated_metrics(self) -> dict:
+        from . import metrics as metrics_mod
+        with self._lock:
+            snaps = dict(self._metric_snaps)
+            head_counters = {
+                "head_pending_tasks": float(len(self._pending)),
+                "head_inflight_tasks": float(len(self._inflight)),
+                "head_lease_queue_depth": float(len(self._lease_queue)),
+                "nodes_alive": float(sum(
+                    1 for n in self._nodes.values() if n.alive)),
+                "workers_registered": float(len(self._workers)),
+                "workers_leased": float(sum(
+                    1 for w in self._workers.values()
+                    if w.leased_to is not None)),
+                "actors_alive": float(sum(
+                    1 for a in self._actors.values()
+                    if a.state == ALIVE)),
+            }
+        agg = metrics_mod.aggregate(snaps)
+        # Head-derived quantities are point-in-time gauges.
+        agg["gauges"].update(head_counters)
+        return agg
+
+    def _h_get_metrics(self, conn, msg):
+        conn.reply(msg, metrics=self._aggregated_metrics())
+
+    def _start_metrics_http(self, port: int):
+        import http.server
+
+        from . import metrics as metrics_mod
+        head = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                agg = head._aggregated_metrics()
+                if self.path.startswith("/metrics.json"):
+                    import json as _json
+                    body = _json.dumps(agg).encode()
+                    ctype = "application/json"
+                else:
+                    body = metrics_mod.prometheus_text(agg).encode()
+                    ctype = "text/plain; version=0.0.4"
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *args):
+                pass
+
+        self._metrics_http = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", port), Handler)
+        threading.Thread(target=self._metrics_http.serve_forever,
+                         daemon=True, name="metrics-http").start()
+        logger.info("metrics endpoint on 127.0.0.1:%d/metrics", port)
+
     def _publish(self, channel: str, data):
         with self._lock:
-            subs = list(self._subs.get(channel, ()))
+            subs = set(self._subs.get(channel, ()))
+            if channel in ("error", "logs"):
+                # Driver consoles always receive error + log streams
+                # (parity: worker.py:910/:1006 listener threads).
+                subs |= self._drivers
         for c in subs:
             try:
                 c.send({"kind": "publish", "channel": channel, "data": data})
@@ -431,20 +520,29 @@ class HeadServer:
             self._schedule_locked()
 
     def _release_leases_of(self, caller: str):
-        """Caller process died/disconnected: its leased workers return to
-        the pool; its queued lease demand evaporates."""
+        """Caller process died/disconnected: its queued lease demand
+        evaporates and its leased workers are shut down — they may still
+        be executing a pipeline of the dead caller's tasks, so re-idling
+        them would stall the next tenant behind orphaned work."""
+        victims = []
         with self._lock:
             for w in self._workers.values():
                 if w.leased_to == caller:
                     node = self._nodes.get(w.node_id)
                     if node is not None:
                         node.release(w.lease_resources or {})
-                        node.idle.append(w.addr)
                     w.leased_to = None
                     w.lease_resources = None
+                    victims.append(w)
             self._lease_queue = [r for r in self._lease_queue
                                  if r[0] != caller]
             self._schedule_locked()
+        for w in victims:
+            if w.conn is not None:
+                try:
+                    w.conn.send({"kind": "shutdown"})
+                except protocol.ConnectionClosed:
+                    pass
 
     def _h_task_done(self, conn, msg):
         task_id: TaskID = msg["task_id"]

@@ -1,0 +1,79 @@
+"""Worker-log tailer: session log files -> driver console.
+
+Parity: `python/ray/log_monitor.py:36` tails worker logs into Redis
+pub/sub and `worker.py:910` prints them on the driver. Here a tailer
+thread per node (head for node0, each node agent for its own dir)
+follows `*.out` files in the session log directory and publishes new
+lines on the "logs" channel; driver runtimes print them prefixed with
+their origin.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+from typing import Callable, Dict
+
+# Per-file, per-tick read cap: a worker spewing output cannot wedge the
+# tailer or flood the control plane.
+MAX_CHUNK = 32 * 1024
+
+
+class LogTailer(threading.Thread):
+    def __init__(self, log_dir: str, node_id: str,
+                 publish: Callable[[dict], None],
+                 interval_s: float = 0.25):
+        super().__init__(daemon=True, name=f"log-tailer-{node_id}")
+        self.log_dir = log_dir
+        self.node_id = node_id
+        self.publish = publish
+        self.interval_s = interval_s
+        self._offsets: Dict[str, int] = {}
+        self._stopped = threading.Event()
+
+    def stop(self):
+        self._stopped.set()
+
+    def run(self):
+        while not self._stopped.is_set():
+            try:
+                self.poll_once()
+            except Exception:
+                pass
+            self._stopped.wait(self.interval_s)
+
+    def poll_once(self):
+        for path in glob.glob(os.path.join(self.log_dir, "*.out")):
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                continue
+            # Log dirs are per-session (fresh), so new files tail from
+            # the start — output written between file creation and the
+            # tailer's first sighting must not be dropped.
+            offset = self._offsets.setdefault(path, 0)
+            if size <= offset:
+                if size < offset:  # truncated/rotated
+                    self._offsets[path] = 0
+                continue
+            with open(path, "rb") as f:
+                f.seek(offset)
+                chunk = f.read(MAX_CHUNK)
+            # Only ship whole lines; partial tails wait for the next tick.
+            cut = chunk.rfind(b"\n")
+            if cut < 0:
+                continue
+            chunk = chunk[:cut + 1]
+            self._offsets[path] = offset + len(chunk)
+            # MAX_CHUNK already bounds the payload; ship every line the
+            # offset advanced past (a partial ship would silently lose
+            # the rest forever).
+            lines = chunk.decode("utf-8", errors="replace").splitlines()
+            if lines:
+                self.publish({
+                    "node": self.node_id,
+                    "file": os.path.basename(path),
+                    "lines": lines,
+                })

@@ -1,0 +1,79 @@
+"""Metrics registry: counters + gauges, cluster-aggregated at the head.
+
+Parity: the reference's OpenCensus measures + Prometheus exposer
+(`src/ray/stats/metric.h:7-10`, definitions `metric_defs.h:23`, wired in
+daemon mains `raylet/main.cc:27-30`). The TPU re-architecture keeps the
+shape — every process owns a cheap in-process registry; the head
+aggregates (sum per metric name, per node) from periodic pushes — and
+serves both machine formats:
+
+  - JSON over the control protocol (`get_metrics`) for `ray_tpu stat
+    --metrics` and programmatic use;
+  - Prometheus text exposition over HTTP when `RAY_TPU_METRICS_PORT` is
+    set (the head binds it; scrape `/metrics`).
+
+Usage from anywhere inside the runtime (driver, worker, head):
+
+    from ray_tpu._private import metrics
+    metrics.inc("tasks_executed")
+    metrics.set_gauge("store_used_bytes", n)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+_lock = threading.Lock()
+_counters: Dict[str, float] = {}
+_gauges: Dict[str, float] = {}
+
+
+def inc(name: str, value: float = 1.0) -> None:
+    with _lock:
+        _counters[name] = _counters.get(name, 0.0) + value
+
+
+def set_gauge(name: str, value: float) -> None:
+    with _lock:
+        _gauges[name] = float(value)
+
+
+def snapshot() -> Dict[str, Dict[str, float]]:
+    """This process's registry: {"counters": {...}, "gauges": {...}}."""
+    with _lock:
+        return {"counters": dict(_counters), "gauges": dict(_gauges)}
+
+
+def reset() -> None:
+    """Test helper."""
+    with _lock:
+        _counters.clear()
+        _gauges.clear()
+
+
+def aggregate(per_process: Dict[str, dict]) -> Dict[str, dict]:
+    """Merge per-process snapshots: counters sum, gauges sum (they are
+    per-process quantities like store bytes; a cluster total is the
+    meaningful roll-up)."""
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, float] = {}
+    for snap in per_process.values():
+        for k, v in (snap.get("counters") or {}).items():
+            counters[k] = counters.get(k, 0.0) + v
+        for k, v in (snap.get("gauges") or {}).items():
+            gauges[k] = gauges.get(k, 0.0) + v
+    return {"counters": counters, "gauges": gauges}
+
+
+def prometheus_text(agg: Dict[str, dict],
+                    prefix: str = "ray_tpu_") -> str:
+    """Prometheus text exposition format (one TYPE line per metric)."""
+    out = []
+    for name, value in sorted((agg.get("counters") or {}).items()):
+        out.append(f"# TYPE {prefix}{name} counter")
+        out.append(f"{prefix}{name} {value:g}")
+    for name, value in sorted((agg.get("gauges") or {}).items()):
+        out.append(f"# TYPE {prefix}{name} gauge")
+        out.append(f"{prefix}{name} {value:g}")
+    return "\n".join(out) + "\n"

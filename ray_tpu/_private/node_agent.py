@@ -61,6 +61,22 @@ class NodeAgent:
         self._monitor_thread = threading.Thread(
             target=self._monitor_loop, daemon=True, name="agent-monitor")
         self._monitor_thread.start()
+        # Tail this node's worker logs to the driver console via head
+        # pub/sub (parity: log_monitor.py on every node).
+        self._log_tailer = None
+        if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+            from .log_tailer import LogTailer
+            self._log_tailer = LogTailer(
+                os.path.join(session_dir, "logs"), node_id,
+                publish=self._publish_logs)
+            self._log_tailer.start()
+
+    def _publish_logs(self, data: dict):
+        try:
+            self.head.send({"kind": "publish", "channel": "logs",
+                            "data": data})
+        except protocol.ConnectionClosed:
+            pass
 
     # ------------------------------------------------------------------
     def _handle(self, conn: protocol.Connection, msg: dict):

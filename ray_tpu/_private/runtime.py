@@ -330,6 +330,14 @@ class Runtime:
 
         from .profiling import Profiler
         self.profiler = Profiler(self, role)
+        # Periodic metric pushes to the head (parity: reporter.py psutil
+        # stats + OpenCensus flushes; `ray_tpu stat --metrics` reads the
+        # head-side aggregate).
+        self._metrics_interval = float(
+            os.environ.get("RAY_TPU_METRICS_INTERVAL_S", "2.0"))
+        if self._metrics_interval > 0:
+            threading.Thread(target=self._metrics_push_loop, daemon=True,
+                             name="metrics-push").start()
         from . import object_ref as object_ref_mod
         object_ref_mod.set_ref_tracker(self.ref_tracker)
         # Workers call start_task_loop() AFTER worker_state is set —
@@ -706,6 +714,8 @@ class Runtime:
                 old_tid, _ = self._result_specs.popitem(last=False)
                 self._reconstruct_budget.pop(old_tid, None)
                 self._freed_returns.pop(old_tid, None)
+        from . import metrics as metrics_mod
+        metrics_mod.inc("tasks_submitted")
         if self._use_leases and self._submit_leased(spec):
             return [ObjectRef(oid, self.addr) for oid in spec.return_ids()]
         self.head.send({"kind": "submit_task", "spec": spec})
@@ -774,7 +784,12 @@ class Runtime:
         g.leases[addr].add(spec.task_id)
         g.idle_since.pop(addr, None)
         self._leased_pending.setdefault(addr, {})[spec.task_id] = spec
-        self._leased_tid_addr[spec.task_id] = (addr, time.monotonic())
+        # Queue position at push: the latency sample divides by it so
+        # the EMA approximates SERVICE time, not sojourn time — sampling
+        # sojourn would make deep pipelines look slow and the adaptive
+        # depth flap between deep and shallow.
+        self._leased_tid_addr[spec.task_id] = (
+            addr, time.monotonic(), len(g.leases[addr]))
 
     def _push_leased(self, addr: str, spec: TaskSpec):
         spec.leased = True
@@ -821,7 +836,7 @@ class Runtime:
             entry = self._leased_tid_addr.pop(tid, None)
             if entry is None:
                 return
-            addr, t_push = entry
+            addr, t_push, pos = entry
             pend = self._leased_pending.get(addr)
             if pend is not None:
                 pend.pop(tid, None)
@@ -829,7 +844,7 @@ class Runtime:
             g = self._lease_groups.get(key) if key is not None else None
             if g is None:
                 return
-            sample = time.monotonic() - t_push
+            sample = (time.monotonic() - t_push) / max(1, pos)
             g.ema_latency_s = sample if g.ema_latency_s is None \
                 else 0.8 * g.ema_latency_s + 0.2 * sample
             g.leases.get(addr, set()).discard(tid)
@@ -1041,6 +1056,30 @@ class Runtime:
 
     def cluster_info(self) -> dict:
         return self.head.request({"kind": "cluster_info"}, timeout=30)["info"]
+
+    def cluster_metrics(self) -> dict:
+        """Cluster-aggregated counters/gauges from the head."""
+        return self.head.request({"kind": "get_metrics"},
+                                 timeout=30)["metrics"]
+
+    def _metrics_push_loop(self):
+        from . import metrics as metrics_mod
+        while not self._shutdown_event.wait(self._metrics_interval):
+            try:
+                metrics_mod.set_gauge("store_used_bytes",
+                                      self.shm.used_bytes())
+                with self._owned_lock:
+                    metrics_mod.set_gauge("owned_objects",
+                                          float(len(self._owned)))
+                snap = metrics_mod.snapshot()
+                self.head.send({"kind": "metrics_push",
+                                "node": self.node_id,
+                                "counters": snap["counters"],
+                                "gauges": snap["gauges"]})
+            except protocol.ConnectionClosed:
+                return
+            except Exception:
+                pass
 
     def get_profile_events(self) -> list:
         self.profiler.flush()
@@ -1297,6 +1336,11 @@ class Runtime:
         elif channel == "error":
             data = msg["data"]
             print(f"[ray_tpu] remote error: {data}", flush=True)
+        elif channel == "logs":
+            data = msg["data"]
+            origin = f"{data.get('node', '?')}/{data.get('file', '?')}"
+            for line in data.get("lines", ()):
+                print(f"({origin}) {line}", flush=True)
 
     # ==================================================================
     # execution (worker role)
@@ -1452,6 +1496,8 @@ class Runtime:
                              node=spec.caller_node)
 
     def _execute_normal(self, spec: TaskSpec):
+        from . import metrics as metrics_mod
+        metrics_mod.inc("tasks_executed")
         try:
             fn = self.load_function(spec.function_key)
         except Exception as e:
@@ -1536,6 +1582,8 @@ class Runtime:
             actor.executor.submit(self._run_actor_task, actor, spec)
 
     def _run_actor_task(self, actor: ActorState, spec: TaskSpec):
+        from . import metrics as metrics_mod
+        metrics_mod.inc("actor_tasks_executed")
         try:
             method = getattr(actor.instance, spec.method_name)
         except AttributeError as e:

@@ -188,6 +188,9 @@ class AnakinOptimizer(PolicyOptimizer):
         self.num_steps_sampled += n
         self.num_steps_trained += n
         policy.global_timestep += n
+        from ..._private import metrics as metrics_mod
+        metrics_mod.inc("rllib_steps_trained", n)
+        metrics_mod.inc("rllib_steps_sampled", n)
         cnt = stats.pop("_ep_count")
         rew_sum = stats.pop("_ep_reward_sum")
         len_sum = stats.pop("_ep_len_sum")

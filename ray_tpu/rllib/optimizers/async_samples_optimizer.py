@@ -89,6 +89,8 @@ class LearnerThread(threading.Thread):
                     stats = policy.learn_on_batch(batch)
             self.stats = stats
         self.weights_updated = True
+        from ..._private import metrics as metrics_mod
+        metrics_mod.inc("rllib_steps_trained", batch.count)
         self.outqueue.put(batch.count)
 
     def stop(self):

@@ -144,6 +144,16 @@ def cmd_stat(args):
     address = _resolve_address(args)
     conn = _connect(address)
     try:
+        if getattr(args, "metrics", False):
+            agg = conn.request({"kind": "get_metrics"},
+                               timeout=30)["metrics"]
+            print("counters:")
+            for k, v in sorted(agg.get("counters", {}).items()):
+                print(f"  {k:<32s} {v:g}")
+            print("gauges:")
+            for k, v in sorted(agg.get("gauges", {}).items()):
+                print(f"  {k:<32s} {v:g}")
+            return
         info = conn.request({"kind": "cluster_info"}, timeout=30)["info"]
     finally:
         conn.close()
@@ -223,6 +233,10 @@ def main(argv=None):
         p.add_argument("--address", default=None)
         if name == "timeline":
             p.add_argument("--out", default=None)
+        if name == "stat":
+            p.add_argument("--metrics", action="store_true",
+                           help="print cluster-aggregated counters/"
+                                "gauges instead of resource state")
         p.set_defaults(fn=fn)
 
     args = parser.parse_args(argv)
