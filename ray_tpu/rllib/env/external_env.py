@@ -45,6 +45,16 @@ class ExternalEnv(threading.Thread):
         self._obs_q.put(("obs", observation, self._take_reward()))
         return self._action_q.get()
 
+    def log_action(self, episode_id: str, observation, action):
+        """Record an off-policy step: the external actor chose `action`
+        itself. The environment trajectory follows the logged action;
+        note the sampled batch still carries the POLICY's would-be
+        action/logp for this observation (full off-policy relabeling is
+        not implemented — same caveat class as the reference's
+        log_action with on-policy algorithms)."""
+        self._obs_q.put(("obs", observation, self._take_reward()))
+        self._action_q.get()  # discard the policy's choice
+
     def log_returns(self, episode_id: str, reward: float):
         self._episode_reward += float(reward)
 

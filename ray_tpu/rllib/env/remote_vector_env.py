@@ -1,0 +1,90 @@
+"""RemoteVectorEnv: env-per-actor stepping with batched inference.
+
+Parity: `rllib/env/remote_vector_env.py` — each env slot lives in its
+own actor process (for envs that are expensive, stateful services, or
+hold their own native resources), while the sampler still sees one
+vectorized env and runs ONE batched `compute_actions` per step across
+all slots. Enabled with config `remote_worker_envs: True`.
+
+All slots step concurrently (`step.remote` fan-out, one `get` barrier),
+so a slow env overlaps the others — the actor-side analogue of the
+reference's poll-based remote env.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+import ray_tpu
+
+
+class _EnvActor:
+    """One env slot hosted in an actor process."""
+
+    def __init__(self, env_creator, env_config):
+        self.env = env_creator(env_config)
+
+    def reset(self):
+        return self.env.reset()
+
+    def step(self, action):
+        obs, reward, done, _ = self.env.step(action)
+        return obs, float(reward), bool(done)
+
+    def spaces(self):
+        return self.env.observation_space, self.env.action_space
+
+    def seed(self, seed):
+        self.env.seed(seed)
+
+    def close(self):
+        self.env.close()
+
+
+class RemoteVectorEnv:
+    """VectorEnv-compatible (reset/reset_at/step) over env actors."""
+
+    def __init__(self, env_creator: Callable, num_envs: int,
+                 env_config: dict = None):
+        remote_cls = ray_tpu.remote(_EnvActor)
+        self.actors = [
+            remote_cls.options(num_cpus=0).remote(
+                env_creator, dict(env_config or {}))
+            for _ in range(num_envs)]
+        self.num_envs = num_envs
+        self.observation_space, self.action_space = ray_tpu.get(
+            self.actors[0].spaces.remote())
+
+    def seed(self, seed: int):
+        ray_tpu.get([a.seed.remote(seed + i)
+                     for i, a in enumerate(self.actors)])
+
+    def reset(self) -> np.ndarray:
+        return np.stack(ray_tpu.get(
+            [a.reset.remote() for a in self.actors]))
+
+    def reset_at(self, i: int):
+        return ray_tpu.get(self.actors[i].reset.remote())
+
+    def step(self, actions):
+        out = ray_tpu.get([a.step.remote(action)
+                           for a, action in zip(self.actors, actions)])
+        obs, rewards, dones = zip(*out)
+        return (np.stack(obs), np.asarray(rewards, dtype=np.float32),
+                np.asarray(dones), [{} for _ in out])
+
+    def close(self):
+        # Graceful first: the hosted env's close() may flush buffers /
+        # release external resources; then reap the actor process.
+        try:
+            ray_tpu.get([a.close.remote() for a in self.actors],
+                        timeout=10)
+        except Exception:
+            pass
+        for a in self.actors:
+            try:
+                ray_tpu.kill(a)
+            except Exception:
+                pass

@@ -43,3 +43,7 @@ class VectorEnv:
             infos.append(i)
         return (np.stack(obs_list), np.asarray(rewards, dtype=np.float32),
                 np.asarray(dones), infos)
+
+    def close(self):
+        for e in self.envs:
+            e.close()

@@ -44,13 +44,24 @@ class RolloutWorker:
         self._init_offline_io(policy_config)
         multiagent = (policy_config.get("multiagent") or {}).get("policies")
         if multiagent:
+            if policy_config.get("remote_worker_envs"):
+                raise NotImplementedError(
+                    "remote_worker_envs is not supported with a policy "
+                    "map yet (the multi-agent sampler builds in-process "
+                    "envs)")
             self._init_multiagent(
                 env_creator, policy_cls, policy_config, num_envs,
                 rollout_fragment_length, seed, explore, env_config,
                 horizon)
             return
         self.policy_map = None
-        self.env = VectorEnv(lambda: env_creator(env_config), num_envs)
+        if policy_config.get("remote_worker_envs"):
+            # Env-per-actor stepping (reference: RemoteVectorEnv).
+            from ..env.remote_vector_env import RemoteVectorEnv
+            self.env = RemoteVectorEnv(
+                env_creator, num_envs, env_config)
+        else:
+            self.env = VectorEnv(lambda: env_creator(env_config), num_envs)
         if seed is not None:
             self.env.seed(seed + worker_index * 1000)
             np.random.seed(seed + worker_index * 1000)
@@ -297,7 +308,7 @@ class RolloutWorker:
         if hasattr(self.sampler, "stop"):
             self.sampler.stop()
         if self.env is not None:
-            self.env.envs and [e.close() for e in self.env.envs]
+            self.env.close()
         elif self.policy_map is not None:
             for e in self.sampler.envs:
                 e.close()

@@ -1,0 +1,71 @@
+"""REST policy client: drive episodes against a PolicyServer.
+
+Parity: `rllib/utils/policy_client.py` (same five commands), built on
+stdlib urllib so the client needs nothing beyond this file + pickle.
+The typical loop, from a process/machine OUTSIDE the cluster:
+
+    client = PolicyClient("127.0.0.1:9900")
+    eid = client.start_episode()
+    action = client.get_action(eid, obs)
+    client.log_returns(eid, reward)
+    ...
+    client.end_episode(eid, last_obs)
+"""
+
+from __future__ import annotations
+
+import pickle
+import urllib.request
+from typing import Optional
+
+from .policy_server import Commands
+
+
+class PolicyClient:
+    def __init__(self, address: str, timeout: float = 60.0):
+        if not address.startswith("http"):
+            address = "http://" + address
+        self._address = address
+        self._timeout = timeout
+
+    def _send(self, data: dict) -> dict:
+        req = urllib.request.Request(
+            self._address, data=pickle.dumps(data),
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=self._timeout) as resp:
+            return pickle.loads(resp.read())
+
+    def start_episode(self, episode_id: Optional[str] = None) -> str:
+        return self._send({
+            "command": Commands.START_EPISODE,
+            "episode_id": episode_id,
+        })["episode_id"]
+
+    def get_action(self, episode_id: str, observation):
+        return self._send({
+            "command": Commands.GET_ACTION,
+            "episode_id": episode_id,
+            "observation": observation,
+        })["action"]
+
+    def log_action(self, episode_id: str, observation, action) -> None:
+        self._send({
+            "command": Commands.LOG_ACTION,
+            "episode_id": episode_id,
+            "observation": observation,
+            "action": action,
+        })
+
+    def log_returns(self, episode_id: str, reward: float) -> None:
+        self._send({
+            "command": Commands.LOG_RETURNS,
+            "episode_id": episode_id,
+            "reward": reward,
+        })
+
+    def end_episode(self, episode_id: str, observation) -> None:
+        self._send({
+            "command": Commands.END_EPISODE,
+            "episode_id": episode_id,
+            "observation": observation,
+        })
