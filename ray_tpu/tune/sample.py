@@ -41,23 +41,67 @@ def grid_search(values: Sequence) -> dict:
     return {"grid_search": list(values)}
 
 
-def uniform(low: float, high: float) -> sample_from:
-    return sample_from(lambda spec: random.uniform(low, high))
+class Domain(sample_from):
+    """A sample_from that also EXPOSES its distribution parameters, so
+    model-based searchers (TPE/BOHB) can reason about the space while
+    grid/random generation keeps working unchanged."""
 
 
-def loguniform(low: float, high: float, base: float = 10.0) -> sample_from:
-    import math
-    lo, hi = math.log(low, base), math.log(high, base)
-    return sample_from(lambda spec: base ** random.uniform(lo, hi))
+class Uniform(Domain):
+    def __init__(self, low: float, high: float):
+        self.low, self.high = float(low), float(high)
+        super().__init__(lambda spec: random.uniform(self.low, self.high))
+
+    def __repr__(self):
+        return f"uniform({self.low}, {self.high})"
 
 
-def choice(options: Sequence) -> sample_from:
-    options = list(options)
-    return sample_from(lambda spec: random.choice(options))
+class LogUniform(Domain):
+    def __init__(self, low: float, high: float, base: float = 10.0):
+        import math
+        self.low, self.high, self.base = float(low), float(high), base
+        self._lo = math.log(low, base)
+        self._hi = math.log(high, base)
+        super().__init__(
+            lambda spec: base ** random.uniform(self._lo, self._hi))
+
+    def __repr__(self):
+        return f"loguniform({self.low}, {self.high})"
 
 
-def randint(low: int, high: int) -> sample_from:
-    return sample_from(lambda spec: random.randint(low, high - 1))
+class Choice(Domain):
+    def __init__(self, options: Sequence):
+        self.options = list(options)
+        super().__init__(lambda spec: random.choice(self.options))
+
+    def __repr__(self):
+        return f"choice({self.options})"
+
+
+class RandInt(Domain):
+    def __init__(self, low: int, high: int):
+        self.low, self.high = int(low), int(high)
+        super().__init__(
+            lambda spec: random.randint(self.low, self.high - 1))
+
+    def __repr__(self):
+        return f"randint({self.low}, {self.high})"
+
+
+def uniform(low: float, high: float) -> Uniform:
+    return Uniform(low, high)
+
+
+def loguniform(low: float, high: float, base: float = 10.0) -> LogUniform:
+    return LogUniform(low, high, base)
+
+
+def choice(options: Sequence) -> Choice:
+    return Choice(options)
+
+
+def randint(low: int, high: int) -> RandInt:
+    return RandInt(low, high)
 
 
 def randn(mean: float = 0.0, sd: float = 1.0) -> sample_from:

@@ -1,9 +1,10 @@
 from .trial_scheduler import FIFOScheduler, TrialScheduler
 from .async_hyperband import ASHAScheduler, AsyncHyperBandScheduler
+from .hb_bohb import HyperBandForBOHB
 from .hyperband import HyperBandScheduler
 from .median_stopping_rule import MedianStoppingRule
 from .pbt import PopulationBasedTraining
 
 __all__ = ["ASHAScheduler", "AsyncHyperBandScheduler", "FIFOScheduler",
-           "HyperBandScheduler", "MedianStoppingRule",
+           "HyperBandForBOHB", "HyperBandScheduler", "MedianStoppingRule",
            "PopulationBasedTraining", "TrialScheduler"]

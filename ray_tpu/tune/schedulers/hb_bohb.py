@@ -1,0 +1,52 @@
+"""BOHB: HyperBand scheduling + model-based (TPE) configuration search.
+
+Parity: `python/ray/tune/schedulers/hb_bohb.py` (HyperBandForBOHB),
+which pairs the HyperBand bracket machinery with the external TuneBOHB
+searcher. Here the pairing is with the native `TPESearcher`
+(`tune/suggest/tpe.py`): every milestone result feeds the searcher as a
+budget-tagged observation, so suggestions for later trials are drawn
+from the model trained at the largest budget with enough data — the
+BOHB KDE-per-budget rule (Falkner et al., 2018).
+
+Usage:
+
+    searcher = TPESearcher(metric="loss", mode="min")
+    tune.run(trainable,
+             config=space, num_samples=27,
+             scheduler=HyperBandForBOHB(metric="loss", mode="min",
+                                        searcher=searcher),
+             search_alg=SearchGenerator(searcher, max_concurrent=3))
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..trial import Trial
+from .hyperband import HyperBandScheduler
+from .trial_scheduler import TrialScheduler
+
+
+class HyperBandForBOHB(HyperBandScheduler):
+    def __init__(self,
+                 time_attr: str = "training_iteration",
+                 metric: str = "episode_reward_mean",
+                 mode: str = "max",
+                 max_t: float = 81,
+                 reduction_factor: float = 3,
+                 searcher=None):
+        super().__init__(time_attr=time_attr, metric=metric, mode=mode,
+                         max_t=max_t, reduction_factor=reduction_factor)
+        self.searcher = searcher
+
+    def on_trial_result(self, trial_runner, trial: Trial,
+                        result: dict) -> str:
+        # Budget-tagged feedback: a trial halted at a low rung still
+        # informs the model at that budget.
+        if self.searcher is not None and self._metric in result:
+            budget = int(result.get(self._time_attr, 1) or 1)
+            self.searcher.record(trial.trial_id, result, budget=budget)
+        return super().on_trial_result(trial_runner, trial, result)
+
+    def debug_string(self) -> str:
+        return f"BOHB(HyperBand): {len(self._brackets)} brackets"

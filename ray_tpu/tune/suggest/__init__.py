@@ -1,6 +1,9 @@
 from .basic_variant import BasicVariantGenerator
 from .search import SearchAlgorithm
+from .searcher import Searcher, SearchGenerator
+from .tpe import TPESearcher
 from .variant_generator import generate_variants, format_vars
 
-__all__ = ["BasicVariantGenerator", "SearchAlgorithm",
-           "generate_variants", "format_vars"]
+__all__ = ["BasicVariantGenerator", "SearchAlgorithm", "Searcher",
+           "SearchGenerator", "TPESearcher", "generate_variants",
+           "format_vars"]

@@ -1,0 +1,135 @@
+"""Pluggable Searcher interface + the SearchGenerator adapter.
+
+Parity: the reference's `tune/suggest/` layer — a `Searcher` proposes
+configs one trial at a time and observes completions
+(suggest/on_trial_complete, the seam its Ax/HyperOpt/BayesOpt/skopt
+wrappers implement). External optimizer libraries are not vendored
+here; instead `tpe.py` provides a native model-based implementation of
+the same interface, and any user class implementing `Searcher` plugs
+into `tune.run(search_alg=SearchGenerator(searcher, ...))`.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+from typing import Dict, List, Optional
+
+from ..sample import Domain
+from ..trial import Trial
+from .search import SearchAlgorithm
+from .variant_generator import _find_special, _set_path, format_vars
+
+
+class Searcher:
+    """Proposes hyperparameter assignments for the Domain leaves of a
+    search space, learning from completed-trial results."""
+
+    def __init__(self, metric: str = "episode_reward_mean",
+                 mode: str = "max"):
+        if mode not in ("min", "max"):
+            raise ValueError("mode must be 'min' or 'max'")
+        self.metric = metric
+        self.mode = mode
+
+    def set_search_space(self, space: Dict[str, Domain]) -> None:
+        """Called by SearchGenerator with {param_path: Domain}."""
+        self.space = space
+
+    def suggest(self, trial_id: str) -> Optional[Dict[str, object]]:
+        """Return {param_path: value} for a new trial (None = no
+        suggestion available right now)."""
+        raise NotImplementedError
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None,
+                          error: bool = False) -> None:
+        pass
+
+    def on_trial_result(self, trial_id: str, result: dict) -> None:
+        pass
+
+
+class SearchGenerator(SearchAlgorithm):
+    """Adapts a Searcher to the trial-generation interface: pulls up to
+    `num_samples` suggestions, capping outstanding trials at
+    `max_concurrent`, and forwards completion feedback."""
+
+    def __init__(self, searcher: Searcher, max_concurrent: int = 4):
+        self.searcher = searcher
+        self.max_concurrent = max_concurrent
+        self._experiment = None
+        self._space: Dict[str, Domain] = {}
+        self._counter = itertools.count()
+        self._suggested = 0
+        self._live: set = set()
+        self._total = 0
+
+    def add_configurations(self, experiments):
+        experiments = list(experiments)
+        if len(experiments) != 1:
+            raise ValueError(
+                "SearchGenerator drives exactly one experiment")
+        exp = experiments[0]
+        self._experiment = exp
+        self._total = exp.num_samples
+        space: Dict[str, Domain] = {}
+        for path, v in _find_special(exp.config):
+            if isinstance(v, Domain):
+                space["/".join(map(str, path))] = v
+            elif isinstance(v, dict):  # grid_search marker
+                raise ValueError(
+                    "grid_search is not supported with a Searcher; use "
+                    "Domain primitives (tune.uniform/choice/...) only")
+        if not space:
+            raise ValueError(
+                "no searchable Domain parameters found in config")
+        self._space = space
+        self.searcher.set_search_space(space)
+
+    def next_trials(self) -> List[Trial]:
+        out: List[Trial] = []
+        exp = self._experiment
+        while (self._suggested < self._total
+               and len(self._live) < self.max_concurrent):
+            trial_id = f"srch_{next(self._counter)}"
+            resolved = self.searcher.suggest(trial_id)
+            if resolved is None:
+                break
+            config = copy.deepcopy(exp.config)
+            for path_str, value in resolved.items():
+                _set_path(config, tuple(path_str.split("/")), value)
+            # Any non-searched sample_from leaves resolve randomly.
+            for path, v in _find_special(config):
+                if not isinstance(v, (int, float, str, bool)) \
+                        and hasattr(v, "sample"):
+                    _set_path(config, path, v.sample(config))
+            self._suggested += 1
+            self._live.add(trial_id)
+            out.append(Trial(
+                exp.run,
+                config=config,
+                trial_id=trial_id,
+                experiment_tag=f"{self._suggested - 1}_"
+                               + format_vars(resolved),
+                local_dir=exp.local_dir,
+                stopping_criterion=exp.stop,
+                checkpoint_freq=exp.checkpoint_freq,
+                checkpoint_at_end=exp.checkpoint_at_end,
+                keep_checkpoints_num=exp.keep_checkpoints_num,
+                checkpoint_score_attr=exp.checkpoint_score_attr,
+                max_failures=exp.max_failures,
+                evaluated_params=dict(resolved)))
+        return out
+
+    def on_trial_result(self, trial_id: str, result: dict):
+        self.searcher.on_trial_result(trial_id, result)
+
+    def on_trial_complete(self, trial_id: str,
+                          result: Optional[dict] = None,
+                          error: bool = False):
+        self._live.discard(trial_id)
+        self.searcher.on_trial_complete(trial_id, result, error)
+
+    def is_finished(self) -> bool:
+        return self._suggested >= self._total and not self._live

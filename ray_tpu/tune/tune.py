@@ -29,6 +29,7 @@ def run(run_or_experiment,
         config: Optional[dict] = None,
         num_samples: int = 1,
         scheduler=None,
+        search_alg=None,
         local_dir: Optional[str] = None,
         checkpoint_freq: int = 0,
         checkpoint_at_end: bool = False,
@@ -50,12 +51,14 @@ def run(run_or_experiment,
             checkpoint_score_attr=checkpoint_score_attr,
             max_failures=max_failures)
     return run_experiments(
-        [experiment], scheduler=scheduler, resume=resume, verbose=verbose,
+        [experiment], scheduler=scheduler, search_alg=search_alg,
+        resume=resume, verbose=verbose,
         raise_on_failed_trial=raise_on_failed_trial)
 
 
 def run_experiments(experiments,
                     scheduler=None,
+                    search_alg=None,
                     resume: bool = False,
                     verbose: int = 1,
                     raise_on_failed_trial: bool = True
@@ -87,19 +90,53 @@ def run_experiments(experiments,
         except FileNotFoundError:
             logger.warning("resume requested but no experiment state "
                            "found; starting fresh")
+    search = None
     if not trials:
-        search = BasicVariantGenerator()
+        # A Searcher instance is auto-wrapped in its generator adapter.
+        from .suggest.searcher import Searcher, SearchGenerator
+        search = search_alg or BasicVariantGenerator()
+        if isinstance(search, Searcher):
+            search = SearchGenerator(search)
         search.add_configurations(experiments)
         trials = search.next_trials()
     for t in trials:
         runner.add_trial(t)
 
+    # Suggestion-driven searchers emit trials incrementally: feed them
+    # completion results and pull new trials as slots free up
+    # (reference: the TrialRunner<->SearchAlgorithm handshake,
+    # `tune/trial_runner.py` search_alg hooks).
+    notified: set = set()
+
+    def pump_search():
+        if search is None:
+            return
+        for t in runner.get_trials():
+            if t.trial_id in notified:
+                continue
+            if t.status == Trial.TERMINATED:
+                notified.add(t.trial_id)
+                search.on_trial_complete(t.trial_id,
+                                         result=t.last_result)
+            elif t.status == Trial.ERROR:
+                notified.add(t.trial_id)
+                search.on_trial_complete(t.trial_id, error=True)
+        for t in search.next_trials():
+            runner.add_trial(t)
+
     last_debug = 0.0
-    while not runner.is_finished():
+    while not runner.is_finished() or \
+            (search is not None and not search.is_finished()):
+        pump_search()
+        if runner.is_finished():
+            # Searcher momentarily out of suggestions but not finished.
+            time.sleep(0.05)
+            continue
         runner.step()
         if verbose and time.time() - last_debug > 5:
             logger.info(runner.debug_string())
             last_debug = time.time()
+    pump_search()
     runner.checkpoint_experiment()
 
     errored = [t for t in runner.get_trials()
