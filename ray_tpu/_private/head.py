@@ -412,16 +412,19 @@ class HeadServer:
     def _grant_lease_locked(self, caller: str,
                             resources: Dict[str, float]) -> Optional[str]:
         for node in self._nodes.values():
-            if not node.alive or not node.idle or not node.fits(resources):
+            if not node.alive or not node.fits(resources):
                 continue
-            addr = node.idle.popleft()
-            w = self._workers.get(addr)
-            if w is None:
-                continue
-            w.leased_to = caller
-            w.lease_resources = dict(resources)
-            node.acquire(resources)
-            return addr
+            # Drain stale idle entries (dead workers not yet reaped)
+            # instead of abandoning the node after one stale addr.
+            while node.idle:
+                addr = node.idle.popleft()
+                w = self._workers.get(addr)
+                if w is None:
+                    continue
+                w.leased_to = caller
+                w.lease_resources = dict(resources)
+                node.acquire(resources)
+                return addr
         return None
 
     def _grow_pool_for_leases_locked(self, resources: Dict[str, float],
@@ -827,9 +830,16 @@ class HeadServer:
                     target=self._dispatch_when_registered, args=(w, spec),
                     daemon=True).start()
             else:
-                if node.idle:
+                # Drain stale idle entries (dead workers not yet reaped)
+                # the same way _grant_lease_locked does — indexing
+                # _workers directly would KeyError mid-drain.
+                w = None
+                while node.idle:
                     addr = node.idle.popleft()
-                    w = self._workers[addr]
+                    w = self._workers.get(addr)
+                    if w is not None:
+                        break
+                if w is not None:
                     w.current_task = spec
                     node.acquire(spec.resources)
                     self._inflight[spec.task_id] = addr

@@ -64,8 +64,21 @@ class LogTailer(threading.Thread):
             # Only ship whole lines; partial tails wait for the next tick.
             cut = chunk.rfind(b"\n")
             if cut < 0:
-                continue
-            chunk = chunk[:cut + 1]
+                # A full newline-free read means one line exceeds
+                # MAX_CHUNK: ship it so the offset advances (a bare
+                # `continue` would wedge this file's tailing forever).
+                # Back off to a UTF-8 character boundary so a multi-byte
+                # char split at MAX_CHUNK isn't mangled across shipments.
+                if len(chunk) < MAX_CHUNK:
+                    continue
+                while chunk and (chunk[-1] & 0xC0) == 0x80:
+                    chunk = chunk[:-1]
+                if chunk and chunk[-1] >= 0xC0:  # orphaned lead byte
+                    chunk = chunk[:-1]
+                if not chunk:
+                    continue
+            else:
+                chunk = chunk[:cut + 1]
             self._offsets[path] = offset + len(chunk)
             # MAX_CHUNK already bounds the payload; ship every line the
             # offset advanced past (a partial ship would silently lose

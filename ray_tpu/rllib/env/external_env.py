@@ -31,6 +31,12 @@ class ExternalEnv(threading.Thread):
         self._action_q: "queue.Queue" = queue.Queue(1)
         self._episode_reward = 0.0
         self._loop_started = False
+        # Action actually executed for the in-flight step when the user
+        # loop chose it via log_action (off-policy). Carried on the NEXT
+        # obs event so the sampler can relabel the recorded transition.
+        self._pending_logged_action = None
+        self._awaiting_action = False
+        self._pending_obs = None
 
     # -- user-side API (called from run()) -------------------------------
     def run(self):
@@ -42,24 +48,31 @@ class ExternalEnv(threading.Thread):
 
     def get_action(self, episode_id: str, observation):
         """Block until the policy provides an action for `observation`."""
-        self._obs_q.put(("obs", observation, self._take_reward()))
-        return self._action_q.get()
+        self._obs_q.put(("obs", observation, self._take_reward(),
+                         self._pending_logged_action))
+        action = self._action_q.get()
+        self._pending_logged_action = None
+        return action
 
     def log_action(self, episode_id: str, observation, action):
         """Record an off-policy step: the external actor chose `action`
-        itself. The environment trajectory follows the logged action;
-        note the sampled batch still carries the POLICY's would-be
-        action/logp for this observation (full off-policy relabeling is
-        not implemented — same caveat class as the reference's
-        log_action with on-policy algorithms)."""
-        self._obs_q.put(("obs", observation, self._take_reward()))
+        itself. The logged action is threaded back to the sampler via the
+        next obs event (`info["off_policy_action"]`), which substitutes it
+        into the recorded batch and recomputes logp under the current
+        policy (parity: the reference's ExternalEnv stores the logged
+        action in the trajectory, `rllib/env/external_env.py`)."""
+        self._obs_q.put(("obs", observation, self._take_reward(),
+                         self._pending_logged_action))
         self._action_q.get()  # discard the policy's choice
+        self._pending_logged_action = action
 
     def log_returns(self, episode_id: str, reward: float):
         self._episode_reward += float(reward)
 
     def end_episode(self, episode_id: str, observation):
-        self._obs_q.put(("done", observation, self._take_reward()))
+        self._obs_q.put(("done", observation, self._take_reward(),
+                         self._pending_logged_action))
+        self._pending_logged_action = None
 
     def _take_reward(self) -> float:
         r = self._episode_reward
@@ -71,18 +84,30 @@ class ExternalEnv(threading.Thread):
         if not self._loop_started:
             self._loop_started = True
             self.start()
-        kind, obs, _ = self._obs_q.get()
+        if getattr(self, "_awaiting_action", False):
+            # Mid-episode reset (e.g. sampler horizon truncation): the
+            # external world can't be forced to reset — the user loop is
+            # parked waiting for an action for `_pending_obs`. Treat it
+            # as a soft episode boundary: hand back the current obs and
+            # let the episode continue (blocking on the queue here would
+            # deadlock both threads).
+            return self._pending_obs
+        kind, obs, _, _ = self._obs_q.get()
         # an immediate 'done' (empty episode) is skipped
         while kind == "done":
-            kind, obs, _ = self._obs_q.get()
+            kind, obs, _, _ = self._obs_q.get()
         self._pending_obs = obs
+        self._awaiting_action = True
         return obs
 
     def step(self, action):
         self._action_q.put(action)
-        kind, obs, reward = self._obs_q.get()
+        kind, obs, reward, logged = self._obs_q.get()
         done = kind == "done"
-        return obs, reward, done, {}
+        self._pending_obs = obs
+        self._awaiting_action = not done
+        info = {} if logged is None else {"off_policy_action": logged}
+        return obs, reward, done, info
 
     def close(self):
         pass

@@ -30,8 +30,8 @@ class _EnvActor:
         return self.env.reset()
 
     def step(self, action):
-        obs, reward, done, _ = self.env.step(action)
-        return obs, float(reward), bool(done)
+        obs, reward, done, info = self.env.step(action)
+        return obs, float(reward), bool(done), info
 
     def spaces(self):
         return self.env.observation_space, self.env.action_space
@@ -71,9 +71,9 @@ class RemoteVectorEnv:
     def step(self, actions):
         out = ray_tpu.get([a.step.remote(action)
                            for a, action in zip(self.actors, actions)])
-        obs, rewards, dones = zip(*out)
+        obs, rewards, dones, infos = zip(*out)
         return (np.stack(obs), np.asarray(rewards, dtype=np.float32),
-                np.asarray(dones), [{} for _ in out])
+                np.asarray(dones), list(infos))
 
     def close(self):
         # Graceful first: the hosted env's close() may flush buffers /

@@ -151,6 +151,39 @@ class SyncSampler:
                 self._rnn_state = [np.array(s) for s in state_out]
             next_obs, rewards, dones, infos = self.env.step(actions)
             next_obs = self._filter(self._preprocess(next_obs))
+            # ExternalEnv.log_action relabeling: the env executed its OWN
+            # action for this step, delivered via info. Substitute it into
+            # the recorded batch and recompute logp under the current
+            # policy so training labels match the executed trajectory.
+            logged_idx = [i for i in range(self.env.num_envs)
+                          if isinstance(infos[i], dict)
+                          and "off_policy_action" in infos[i]]
+            if logged_idx:
+                logged_acts = np.asarray(
+                    [infos[i]["off_policy_action"] for i in logged_idx])
+                actions = np.array(actions)
+                actions[logged_idx] = logged_acts
+                if sb.ACTION_LOGP in extra:
+                    dist_inputs = extra.get(sb.ACTION_DIST_INPUTS)
+                    dist_class = getattr(self.policy, "dist_class", None)
+                    if dist_inputs is None or dist_class is None:
+                        # Substituting the action while keeping the stale
+                        # logp would silently corrupt importance ratios
+                        # (PPO/V-trace); there's no correct value to
+                        # record.
+                        raise RuntimeError(
+                            "ExternalEnv.log_action requires the policy "
+                            "to expose dist_class + ACTION_DIST_INPUTS "
+                            "so logp can be recomputed for the executed "
+                            "action")
+                    # The dist inputs for this exact obs/state are already
+                    # in hand — no second forward pass needed.
+                    new_logp = np.asarray(dist_class(
+                        np.asarray(dist_inputs)[logged_idx]).logp(
+                            np.asarray(logged_acts)))
+                    logp_col = np.array(extra[sb.ACTION_LOGP])
+                    logp_col[logged_idx] = new_logp
+                    extra = dict(extra, **{sb.ACTION_LOGP: logp_col})
             for i in range(self.env.num_envs):
                 b = self._builders[i]
                 # Horizon truncation is terminal: the chunk is postprocessed

@@ -305,6 +305,37 @@ class JaxPolicy(Policy):
             extra.update(self._extra_action_out_fn(self, extra))
         return np.asarray(actions), state_out, extra
 
+    def compute_log_likelihoods(self, obs_batch, actions,
+                                state_batches=None):
+        """Log-prob of given (possibly externally chosen) actions under the
+        current policy (parity: `rllib/policy/policy.py`
+        compute_log_likelihoods). Used by the sampler to relabel
+        ExternalEnv.log_action steps."""
+        if not hasattr(self, "_logp_fn"):
+            if self.recurrent:
+                def logp_fn(params, obs, state, acts):
+                    obs_bt = obs[:, None]
+                    reset = jnp.zeros((obs.shape[0], 1), jnp.float32)
+                    dist_bt, _, _ = self.apply(params, obs_bt, state, reset)
+                    return self.dist_class(dist_bt[:, 0]).logp(acts)
+            else:
+                def logp_fn(params, obs, acts):
+                    dist_inputs, _ = self.apply(params, obs)
+                    return self.dist_class(dist_inputs).logp(acts)
+            self._logp_fn = jax.jit(logp_fn)
+        obs = jnp.asarray(obs_batch)
+        acts = jnp.asarray(actions)
+        with self._update_lock:
+            if self.recurrent:
+                if not state_batches:
+                    state_batches = self.get_initial_state(len(obs_batch))
+                state = (jnp.asarray(state_batches[0]),
+                         jnp.asarray(state_batches[1]))
+                out = self._logp_fn(self.params, obs, state, acts)
+            else:
+                out = self._logp_fn(self.params, obs, acts)
+        return np.asarray(out)
+
     def value_function(self, obs_batch, state=None):
         obs = jnp.asarray(obs_batch)
         if self.recurrent:

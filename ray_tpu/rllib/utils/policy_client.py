@@ -22,16 +22,20 @@ from .policy_server import Commands
 
 
 class PolicyClient:
-    def __init__(self, address: str, timeout: float = 60.0):
+    def __init__(self, address: str, timeout: float = 60.0,
+                 auth_token: str = None):
         if not address.startswith("http"):
             address = "http://" + address
         self._address = address
         self._timeout = timeout
+        self._auth_token = auth_token
 
     def _send(self, data: dict) -> dict:
+        headers = {"Content-Type": "application/octet-stream"}
+        if self._auth_token is not None:
+            headers["X-Auth-Token"] = self._auth_token
         req = urllib.request.Request(
-            self._address, data=pickle.dumps(data),
-            headers={"Content-Type": "application/octet-stream"})
+            self._address, data=pickle.dumps(data), headers=headers)
         with urllib.request.urlopen(req, timeout=self._timeout) as resp:
             return pickle.loads(resp.read())
 

@@ -60,3 +60,52 @@ class TestExternalEnv:
         assert r["timesteps_this_iter"] >= 256
         assert r["episode_reward_mean"] > 5
         t.stop()
+
+    def test_log_action_relabels_batch(self):
+        """log_action steps must record the EXECUTED (logged) action in
+        the sampled batch, with logp recomputed under the current policy
+        (r3 advisor finding: batches were mislabeled with the policy's
+        discarded choice)."""
+        from ray_tpu.rllib import sample_batch as sb
+        from ray_tpu.rllib.agents.pg.pg import DEFAULT_CONFIG, PGJaxPolicy
+        from ray_tpu.rllib.env.env import CartPole
+        from ray_tpu.rllib.env.external_env import ExternalEnv
+        from ray_tpu.rllib.env.vector_env import VectorEnv
+        from ray_tpu.rllib.evaluation.sampler import SyncSampler
+
+        FORCED = 1  # external controller always picks action 1
+
+        class LoggingCartPole(ExternalEnv):
+            def __init__(self):
+                inner = CartPole()
+                super().__init__(inner.observation_space,
+                                 inner.action_space)
+                self._inner = inner
+
+            def run(self):
+                while True:
+                    eid = self.start_episode()
+                    obs = self._inner.reset()
+                    done = False
+                    while not done:
+                        self.log_action(eid, obs, FORCED)
+                        obs, r, done, _ = self._inner.step(FORCED)
+                        self.log_returns(eid, r)
+                    self.end_episode(eid, obs)
+
+        env = LoggingCartPole()
+        cfg = dict(DEFAULT_CONFIG)
+        cfg.update({"model": {"fcnet_hiddens": [16]}, "seed": 0})
+        policy = PGJaxPolicy(env.observation_space, env.action_space, cfg)
+        sampler = SyncSampler(
+            VectorEnv(lambda: env, num_envs=1), policy,
+            rollout_fragment_length=40)
+        batch = sampler.sample()
+        acts = np.asarray(batch[sb.ACTIONS])
+        # Every recorded action must be the forced one, not the policy's.
+        assert (acts == FORCED).all(), acts
+        # Logp must match the current policy's logp of the forced action.
+        expect = policy.compute_log_likelihoods(
+            np.asarray(batch[sb.OBS]), acts)
+        np.testing.assert_allclose(
+            np.asarray(batch[sb.ACTION_LOGP]), expect, rtol=1e-5)
