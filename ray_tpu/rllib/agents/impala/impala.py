@@ -47,10 +47,17 @@ def make_async_optimizer(workers, config):
         inline_env=config.get("env"),
         inline_num_envs=config.get("_inline_num_envs", 1),
         inline_env_config=config.get("env_config"),
-        inline_seed=config.get("seed"))
+        inline_seed=config.get("seed"),
+        device_rollouts=config.get("device_rollouts", "auto"),
+        device_frame_stack=config.get("device_frame_stack", 0))
 
 
 def validate_config(config):
+    if config.get("device_frame_stack") and \
+            not config.get("num_inline_actors"):
+        raise ValueError(
+            "device_frame_stack only applies to the inline-actor "
+            "(Sebulba) path; set num_inline_actors >= 1")
     if config.get("num_inline_actors"):
         if config.get("num_workers"):
             raise ValueError(

@@ -51,6 +51,13 @@ DEFAULT_CONFIG = with_common_config({
     # num_envs_per_worker, batch-sharded over the learner mesh.
     "anakin": False,
     "anakin_updates_per_call": 10,
+    # Device-resident inline rollouts (`evaluation/device_sampler.py`):
+    # obs ship to HBM once and train in place. "auto" uses them for
+    # feedforward policies; False forces the host-side VectorSampler.
+    "device_rollouts": "auto",
+    # Stack depth for on-device frame stacking (0 = off). Requires an
+    # env that emits single-channel frames (see device_frame_stack.py).
+    "device_frame_stack": 0,
 })
 
 

@@ -54,6 +54,11 @@ COMMON_CONFIG = {
     # === Environment ===
     "env": None,
     "env_config": {},
+    # Compress observation columns (lz4 if available, else zlib) before
+    # sample batches cross the worker->learner process boundary
+    # (parity: `rllib/utils/compression.py` + `compress_observations`).
+    # No effect on inline/device rollouts (no process hop to compress).
+    "compress_observations": False,
     # === Offline I/O (parity: rllib/offline/io_context.py) ===
     # "sampler" = fresh env experience; a path = JSON-lines replay dir.
     "input": "sampler",
@@ -107,6 +112,22 @@ class Trainer(Trainable):
             self.env_creator = lambda cfg, _n=env_name: make_env(_n, cfg)
         else:
             raise ValueError("config['env'] is required")
+        k = merged.get("device_frame_stack") or 0
+        if k:
+            # On-device frame stacking (device_frame_stack.py): the env
+            # emits single frames, the device sampler stacks in HBM. The
+            # probe env must advertise the STACKED space so policies
+            # build the right network.
+            from ..env.device_frame_stack import stacked_space
+            inner_creator = self.env_creator
+
+            def stacked_creator(cfg, _mk=inner_creator, _k=k):
+                env = _mk(cfg)
+                env.observation_space = stacked_space(
+                    env.observation_space, _k)
+                return env
+
+            self.env_creator = stacked_creator
         self._make_mesh()
         self._init(merged, self.env_creator)
 

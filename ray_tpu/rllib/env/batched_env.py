@@ -104,12 +104,13 @@ class BatchedSyntheticAtari(BatchedEnv):
 
     def __init__(self, num_envs: int, episode_len: int = 1000,
                  num_actions: int = 6, pool_size: int = 32,
-                 seed=None):
+                 channels: int = 4, seed=None):
         self.num_envs = num_envs
         self.episode_len = episode_len
         self.num_actions = num_actions
         self.pool_size = pool_size
-        self.observation_space = Box(0, 255, shape=(84, 84, 4),
+        self.channels = channels
+        self.observation_space = Box(0, 255, shape=(84, 84, channels),
                                      dtype=np.uint8)
         self.action_space = Discrete(num_actions)
         self._rng = np.random.default_rng(seed)
@@ -119,13 +120,14 @@ class BatchedSyntheticAtari(BatchedEnv):
 
     def _build_pool(self):
         base = self._rng.integers(
-            0, 64, size=(self.pool_size, 84, 84, 4), dtype=np.uint8)
+            0, 64, size=(self.pool_size, 84, 84, self.channels),
+            dtype=np.uint8)
         band = 84 // self.num_actions
         pool = np.broadcast_to(
             base, (self.num_actions,) + base.shape).copy()
         for a in range(self.num_actions):
             pool[a, :, a * band:(a + 1) * band, :, :] += 128
-        self._pool = pool  # [A, P, 84, 84, 4]
+        self._pool = pool  # [A, P, 84, 84, C]
 
     def seed(self, seed=None):
         self._rng = np.random.default_rng(seed)

@@ -1,0 +1,67 @@
+"""On-device frame stacking: ship one frame per step, stack in HBM.
+
+The reference stacks frames on the HOST (`rllib/env/atari_wrappers.py`
+`FrameStack`: each observation is the last k frames concatenated on the
+channel axis), so every env step ships k frames' worth of bytes to the
+accelerator even though k-1 of them were already there. On TPU the
+host->device link is the scarce resource (SURVEY.md §7.1; the Sebulba
+actor design keeps observations device-resident), so this wrapper moves
+the stack INTO the device pipeline:
+
+- the wrapped env emits only the newest frame ([H, W, 1] per slot);
+- `DeviceSebulbaSampler` maintains the [H, W, k] stack in HBM (roll +
+  insert, reset-filled at episode boundaries), cutting per-step
+  host->device traffic by k x;
+- the advertised `observation_space` is the STACKED space, so policies
+  build exactly the network they would for host-side stacking.
+
+Only the device-rollout sampler understands the single-frame emission
+contract (`device_frame_stack` attribute); host-side samplers must use a
+host `FrameStack` wrapper instead.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .batched_env import BatchedEnv
+from .spaces import Box
+
+
+def stacked_space(base: Box, k: int) -> Box:
+    """The [H, W, 1] frame space stacked to [H, W, k]."""
+    if base.shape[-1] != 1:
+        raise ValueError(
+            f"device frame stacking needs single-channel frames; env "
+            f"emits {base.shape}")
+    shape = base.shape[:-1] + (k,)
+    return Box(low=np.min(base.low), high=np.max(base.high),
+               shape=shape, dtype=base.dtype)
+
+
+class DeviceFrameStack(BatchedEnv):
+    """Wrap a single-frame BatchedEnv; advertise the stacked obs space.
+
+    `vector_reset`/`vector_step` still return raw [N, H, W, 1] frames —
+    the device sampler does the stacking. The `device_frame_stack`
+    attribute is the marker (and stack depth) samplers key on.
+    """
+
+    def __init__(self, inner: BatchedEnv, k: int):
+        self.inner = inner
+        self.device_frame_stack = int(k)
+        self.num_envs = inner.num_envs
+        self.observation_space = stacked_space(inner.observation_space, k)
+        self.action_space = inner.action_space
+
+    def vector_reset(self):
+        return self.inner.vector_reset()
+
+    def vector_step(self, actions):
+        return self.inner.vector_step(actions)
+
+    def seed(self, seed=None):
+        self.inner.seed(seed)
+
+    def close(self):
+        self.inner.close()

@@ -152,11 +152,14 @@ class SyntheticAtari(Env):
     intensity encodes the best action) so policies must do real work.
     """
 
-    def __init__(self, episode_len: int = 1000, num_actions: int = 6):
-        self.observation_space = Box(0, 255, shape=(84, 84, 4), dtype=np.uint8)
+    def __init__(self, episode_len: int = 1000, num_actions: int = 6,
+                 channels: int = 4):
+        self.observation_space = Box(0, 255, shape=(84, 84, channels),
+                                     dtype=np.uint8)
         self.action_space = Discrete(num_actions)
         self.episode_len = episode_len
         self.num_actions = num_actions
+        self.channels = channels
         self._rng = np.random.default_rng()
 
     def reset(self):
@@ -166,7 +169,7 @@ class SyntheticAtari(Env):
 
     def _frame(self):
         frame = self._rng.integers(
-            0, 64, size=(84, 84, 4), dtype=np.uint8)
+            0, 64, size=(84, 84, self.channels), dtype=np.uint8)
         # Embed the target action as a bright band.
         band = 84 // self.num_actions
         frame[self._target * band:(self._target + 1) * band, :, :] += 128

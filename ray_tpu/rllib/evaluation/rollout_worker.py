@@ -38,6 +38,11 @@ class RolloutWorker:
                  horizon: Optional[int] = None,
                  pack_fragments: bool = False):
         self.worker_index = worker_index
+        # Compression only pays where batches cross a process boundary
+        # (remote worker -> learner); the local worker's batches are
+        # consumed in-process.
+        self._compress_observations = bool(
+            policy_config.get("compress_observations")) and worker_index > 0
         env_config = dict(env_config or {})
         env_config["worker_index"] = worker_index
         # Offline I/O (parity: `rollout_worker.py` IOContext wiring).
@@ -216,6 +221,9 @@ class RolloutWorker:
         batch = self.sampler.sample()
         if self._output_writer is not None:
             self._output_writer.write(batch)
+        if self._compress_observations:
+            from ..utils.compression import compress_batch
+            compress_batch(batch)
         return batch
 
     def sample_with_count(self):

@@ -58,6 +58,8 @@ class ReplayActor:
             buffer_size, alpha=prioritized_replay_alpha)
 
     def add_batch(self, batch: SampleBatch) -> int:
+        from ..utils.compression import decompress_batch
+        decompress_batch(batch)
         self.buffer.add_batch(batch)
         if "td_error" in batch:
             # Worker-side initial priorities (dqn_policy.py postprocess).

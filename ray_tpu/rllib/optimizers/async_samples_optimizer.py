@@ -25,6 +25,7 @@ import ray_tpu
 
 from ..sample_batch import SampleBatch
 from ..utils.actors import TaskPool
+from ..utils.compression import decompress_batch
 from ..utils.window_stat import WindowStat
 from .policy_optimizer import PolicyOptimizer
 
@@ -153,7 +154,9 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                  inline_env=None,
                  inline_num_envs: int = 1,
                  inline_env_config=None,
-                 inline_seed=None):
+                 inline_seed=None,
+                 device_rollouts: str = "auto",
+                 device_frame_stack: int = 0):
         super().__init__(workers)
         self.train_batch_size = train_batch_size
         self.rollout_fragment_length = rollout_fragment_length
@@ -180,6 +183,7 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
 
         if num_inline_actors > 0:
             from ..env.registry import make_batched_env
+            from ..evaluation.device_sampler import DeviceSebulbaSampler
             from ..evaluation.vector_sampler import VectorSampler
             policy = workers.local_worker.policy
             mesh = getattr(policy, "mesh", None)
@@ -190,14 +194,30 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                     f"evenly across the learner mesh ({mesh_size} devices)"
                     " — fragment batches (and their per-fragment bootstrap"
                     " rows) are batch-sharded over the mesh")
+            # Device-resident rollouts (see device_sampler.py): the
+            # default for feedforward policies; LSTM keeps the host path.
+            use_device = (
+                device_rollouts is True
+                or (device_rollouts == "auto"
+                    and not getattr(policy, "recurrent", False)))
+            if device_frame_stack and not use_device:
+                raise ValueError(
+                    "device_frame_stack requires device rollouts "
+                    "(feedforward policy + device_rollouts auto/True)")
             for k in range(num_inline_actors):
                 benv = make_batched_env(
                     inline_env, inline_num_envs, inline_env_config,
                     seed=None if inline_seed is None
-                    else inline_seed + 1000 * (k + 1))
-                sampler = VectorSampler(
-                    benv, policy, rollout_fragment_length,
-                    eps_id_offset=(k + 1) << 40)
+                    else inline_seed + 1000 * (k + 1),
+                    device_frame_stack=device_frame_stack)
+                if use_device:
+                    sampler = DeviceSebulbaSampler(
+                        benv, policy, rollout_fragment_length,
+                        eps_id_offset=(k + 1) << 40)
+                else:
+                    sampler = VectorSampler(
+                        benv, policy, rollout_fragment_length,
+                        eps_id_offset=(k + 1) << 40)
                 self._inline_actors.append(
                     InlineActorThread(sampler, self.learner))
             for a in self._inline_actors:
@@ -242,6 +262,7 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         sampled = 0
         for worker, ref in self.sample_tasks.completed(blocking_wait=True):
             batch = ray_tpu.get(ref)
+            decompress_batch(batch)
             sampled += batch.count
             self._batch_buffer.append(batch)
             self._batch_buffer_count += batch.count
@@ -352,6 +373,12 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                     1000 * self.learner.queue_timer.mean, 3),
             },
         })
+        transfer = [a.sampler.transfer_stats()
+                    for a in self._inline_actors
+                    if hasattr(a.sampler, "transfer_stats")]
+        if transfer:
+            out["transfer"] = {
+                k: sum(t[k] for t in transfer) for k in transfer[0]}
         return out
 
     def stop(self):

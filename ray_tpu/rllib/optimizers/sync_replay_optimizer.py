@@ -55,6 +55,9 @@ class SyncReplayOptimizer(PolicyOptimizer):
             self.workers.sync_weights()
             batches = ray_tpu.get(
                 [w.sample.remote() for w in self.workers.remote_workers])
+            from ..utils.compression import decompress_batch
+            for b in batches:
+                decompress_batch(b)
             batch = SampleBatch.concat_samples(batches)
         else:
             batch = self.workers.local_worker.sample()

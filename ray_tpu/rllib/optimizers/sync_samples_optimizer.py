@@ -17,6 +17,7 @@ from __future__ import annotations
 import ray_tpu
 
 from ..sample_batch import MultiAgentBatch, SampleBatch, real_count
+from ..utils.compression import decompress_batch
 from .policy_optimizer import PolicyOptimizer
 
 
@@ -30,6 +31,7 @@ def collect_train_batch(workers, train_batch_size: int):
         while count < train_batch_size:
             refs = [w.sample.remote() for w in workers.remote_workers]
             for b in ray_tpu.get(refs):
+                decompress_batch(b)
                 batches.append(b)
                 count += b.count
     else:

@@ -353,7 +353,18 @@ class JaxPolicy(Policy):
         out = {}
         for k in _DEVICE_COLUMNS:
             if k in batch:
-                v = np.asarray(batch[k])
+                v = batch[k]
+                if isinstance(v, jax.Array):
+                    # Already device-resident (DeviceSebulbaSampler
+                    # rollouts): at most a device-side reshard, never a
+                    # host round-trip.
+                    if v.dtype == jnp.float64:
+                        v = v.astype(jnp.float32)
+                    elif v.dtype == jnp.bool_:
+                        v = v.astype(jnp.float32)
+                    out[k] = jax.device_put(v, self._bsharded)
+                    continue
+                v = np.asarray(v)
                 if v.dtype == np.float64:
                     v = v.astype(np.float32)
                 if v.dtype == np.bool_:
@@ -396,7 +407,9 @@ class JaxPolicy(Policy):
         num_mb = max(1, n // minibatch_size)
         usable = num_mb * minibatch_size
         if sb.BOOTSTRAP_OBS in batch:
-            boot = np.asarray(batch[sb.BOOTSTRAP_OBS])
+            # No np.asarray: the column may be device-resident
+            # (DeviceSebulbaSampler) and must not round-trip the host.
+            boot = batch[sb.BOOTSTRAP_OBS]
             if seq_len <= 1 or len(boot) * seq_len != n:
                 raise ValueError(
                     f"BOOTSTRAP_OBS has {len(boot)} fragments but the "

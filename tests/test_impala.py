@@ -158,7 +158,7 @@ class TestIMPALA:
 
     def test_local_mode_learns(self):
         from ray_tpu.rllib.agents.impala import IMPALATrainer
-        t = IMPALATrainer(config=self._config(lr=0.005))
+        t = IMPALATrainer(config=self._config(lr=0.005, seed=0))
         best = -np.inf
         for i in range(30):
             result = t.train()

@@ -185,6 +185,150 @@ class TestVectorSampler:
 
 
 # ---------------------------------------------------------------------
+# DeviceSebulbaSampler (round 4): device-resident rollouts
+# ---------------------------------------------------------------------
+class _CountingFrameEnv:
+    """BatchedEnv emitting [N, 4, 4, 1] uint8 frames whose value is the
+    global step counter; episodes end every `episode_len` steps."""
+
+    def __init__(self, num_envs, episode_len=3):
+        from ray_tpu.rllib.env.spaces import Box, Discrete
+        self.num_envs = num_envs
+        self.episode_len = episode_len
+        self.observation_space = Box(0, 255, shape=(4, 4, 1),
+                                     dtype=np.uint8)
+        self.action_space = Discrete(2)
+        self._count = 0
+        self._t = np.zeros(num_envs, np.int64)
+
+    def _frames(self):
+        return np.full((self.num_envs, 4, 4, 1), self._count % 256,
+                       np.uint8)
+
+    def vector_reset(self):
+        self._count = 0
+        self._t[:] = 0
+        return self._frames()
+
+    def vector_step(self, actions):
+        self._count += 1
+        self._t += 1
+        dones = self._t >= self.episode_len
+        self._t[dones] = 0
+        return self._frames(), np.zeros(self.num_envs, np.float32), dones
+
+    def seed(self, seed=None):
+        pass
+
+
+class TestDeviceSampler:
+    def _make_policy(self, env):
+        from ray_tpu.rllib.agents.pg.pg import DEFAULT_CONFIG, PGJaxPolicy
+        cfg = dict(DEFAULT_CONFIG)
+        # Tiny conv for the 4x4 test frames (nature CNN needs >= 84x84).
+        cfg.update({"model": {"fcnet_hiddens": [8],
+                              "conv_filters": ((4, 2, 1),)},
+                    "seed": 0})
+        return PGJaxPolicy(env.observation_space, env.action_space, cfg)
+
+    def test_frame_stack_matches_host_semantics(self):
+        """On-device stacking must reproduce host FrameStack exactly:
+        rolling window within an episode, reset-filled at boundaries."""
+        from ray_tpu.rllib.env.device_frame_stack import DeviceFrameStack
+        from ray_tpu.rllib.evaluation.device_sampler import (
+            DeviceSebulbaSampler)
+        K, T, N = 4, 8, 2
+        env = DeviceFrameStack(_CountingFrameEnv(N, episode_len=3), K)
+        policy = self._make_policy(env)
+        sampler = DeviceSebulbaSampler(env, policy,
+                                       rollout_fragment_length=T)
+        batch = sampler.sample()
+        obs = np.asarray(batch[sb.OBS]).reshape(N, T, 4, 4, K)
+        # Host reference: frame value at global step t is t; episodes
+        # are 3 steps long, so stacks reset-fill at t in {0, 3, 6, ...}.
+        def host_stack(t):
+            ep_start = (t // 3) * 3
+            frames = [max(ep_start, t - (K - 1) + i) for i in range(K)]
+            return np.array(frames, np.uint8)
+        for t in range(T):
+            expect = host_stack(t)
+            for i in range(N):
+                np.testing.assert_array_equal(
+                    obs[i, t, 0, 0, :], expect,
+                    err_msg=f"stack mismatch at t={t}")
+        # Bootstrap obs = stack for step T (post-fragment).
+        boot = np.asarray(batch[sb.BOOTSTRAP_OBS])
+        np.testing.assert_array_equal(boot[0, 0, 0, :], host_stack(T))
+        # Accounting: only single frames went up, only actions came back.
+        stats = sampler.transfer_stats()
+        assert stats["steps"] == N * T
+        # Per step: N frames of 16 bytes + N done bytes (+ initial).
+        assert stats["bytes_h2d"] <= (T + 2) * N * (4 * 4 + 1)
+
+    def test_device_batch_columns_stay_on_device(self):
+        """OBS/BOOTSTRAP/dist-inputs columns come back as jax arrays (no
+        host round-trip); host columns stay numpy."""
+        import jax
+        from ray_tpu.rllib.evaluation.device_sampler import (
+            DeviceSebulbaSampler)
+        env = BatchedCartPole(4, seed=0)
+        policy = self._make_policy(env)
+        sampler = DeviceSebulbaSampler(env, policy,
+                                       rollout_fragment_length=5)
+        batch = sampler.sample()
+        assert isinstance(batch[sb.OBS], jax.Array)
+        assert isinstance(batch[sb.BOOTSTRAP_OBS], jax.Array)
+        assert isinstance(batch[sb.ACTION_DIST_INPUTS], jax.Array)
+        assert isinstance(batch[sb.ACTIONS], np.ndarray)
+        assert batch[sb.OBS].shape == (20, 4)
+        assert batch.count == 20
+        # eps ids advance at dones, mirroring VectorSampler bookkeeping.
+        assert batch[sb.EPS_ID].shape == (20,)
+
+    def test_device_rollouts_false_uses_host_sampler(self, ray_session):
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        from ray_tpu.rllib.evaluation.vector_sampler import VectorSampler
+        t = get_trainer_class("IMPALA")(config={
+            "env": "CartPole-v0",
+            "num_workers": 0,
+            "num_inline_actors": 1,
+            "num_envs_per_worker": 8,
+            "rollout_fragment_length": 10,
+            "train_batch_size": 80,
+            "device_rollouts": False,
+            "min_iter_time_s": 0,
+            "seed": 0,
+        })
+        assert isinstance(
+            t.optimizer._inline_actors[0].sampler, VectorSampler)
+        t.train()
+        t.stop()
+
+    def test_impala_frames_env_trains(self, ray_session):
+        """IMPALA over the single-frame env + on-device stacking: the
+        full device-resident pipeline end to end."""
+        from ray_tpu.rllib.agents.registry import get_trainer_class
+        t = get_trainer_class("IMPALA")(config={
+            "env": "SyntheticAtariFrames-v0",
+            "env_config": {"episode_len": 50},
+            "num_workers": 0,
+            "num_inline_actors": 1,
+            "num_envs_per_worker": 8,
+            "rollout_fragment_length": 10,
+            "train_batch_size": 80,
+            "device_frame_stack": 4,
+            "min_iter_time_s": 0,
+            "seed": 0,
+        })
+        r = t.train()
+        assert r["timesteps_this_iter"] >= 80
+        # The policy was built for the STACKED space.
+        pol = t.workers.local_worker.policy
+        assert pol.observation_space.shape == (84, 84, 4)
+        t.stop()
+
+
+# ---------------------------------------------------------------------
 # End-to-end learning (regression-by-learning, SURVEY §4.2 lesson 2)
 # ---------------------------------------------------------------------
 class TestEndToEnd:
