@@ -1,0 +1,119 @@
+"""Catch: an ALE-shaped game for the Atari preprocessing stack.
+
+ALE ROMs aren't shippable in this image, so this is the in-repo stand-in
+that exercises the FULL `atari_wrappers.py` contract the way a real
+Atari env would: 210x160x3 uint8 RGB frames, `.ale.lives()`, NOOP/FIRE
+action meanings (NoopResetEnv/FireResetEnv assertions), flickering
+sprites (MaxAndSkipEnv's max-pool matters), multi-life episodes
+(EpisodicLifeEnv semantics), and a spec id carrying "NoFrameskip".
+
+The game itself is DeepMind's classic Catch benchmark scaled to Atari
+geometry: a ball falls from the top, a paddle moves at the bottom
+(LEFT/RIGHT/NOOP after FIRE launches the ball); catching scores +1,
+missing drops a life (3 lives per game). Solvable by the Nature CNN
+from pixels, so learns-to-target regression tests have a real Atari-
+shaped task.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .env import Env
+from .spaces import Box, Discrete
+
+H, W = 210, 160
+PADDLE_W = 16
+BALL = 8
+
+
+class _FakeALEHandle:
+    """The `.ale` attribute wrappers probe (`EpisodicLifeEnv`)."""
+
+    def __init__(self, env):
+        self._env = env
+
+    def lives(self) -> int:
+        return self._env._lives
+
+
+class CatchALE(Env):
+    """Actions: 0=NOOP, 1=FIRE, 2=RIGHT, 3=LEFT (ALE ordering)."""
+
+    spec_id = "CatchNoFrameskip-v4"
+
+    def __init__(self, lives: int = 3, flicker: bool = True,
+                 fall_speed: int = 6, paddle_speed: int = 8):
+        self.observation_space = Box(0, 255, shape=(H, W, 3),
+                                     dtype=np.uint8)
+        self.action_space = Discrete(4)
+        self.ale = _FakeALEHandle(self)
+        self.flicker = flicker
+        self.fall_speed = fall_speed
+        self.paddle_speed = paddle_speed
+        self.start_lives = lives
+        self._rng = np.random.default_rng()
+        self._lives = lives
+        self._frame_no = 0
+        self._launched = False
+        self._reset_round()
+
+    def get_action_meanings(self):
+        return ["NOOP", "FIRE", "RIGHT", "LEFT"]
+
+    def seed(self, seed=None):
+        self._rng = np.random.default_rng(seed)
+
+    def _reset_round(self):
+        self._ball_x = int(self._rng.integers(0, W - BALL))
+        self._ball_y = 0
+        self._paddle_x = (W - PADDLE_W) // 2
+        self._launched = False
+
+    def reset(self):
+        self._lives = self.start_lives
+        self._frame_no = 0
+        self._reset_round()
+        return self._render()
+
+    def step(self, action):
+        action = int(np.asarray(action).reshape(()))
+        self._frame_no += 1
+        reward = 0.0
+        if not self._launched:
+            # Fixed until firing (FireResetEnv's contract).
+            if action == 1:
+                self._launched = True
+            return self._render(), 0.0, False, {}
+        if action == 2:
+            self._paddle_x = min(W - PADDLE_W,
+                                 self._paddle_x + self.paddle_speed)
+        elif action == 3:
+            self._paddle_x = max(0, self._paddle_x - self.paddle_speed)
+        self._ball_y += self.fall_speed
+        done = False
+        if self._ball_y + BALL >= H - 8:  # reached the paddle row
+            caught = (self._paddle_x - BALL < self._ball_x
+                      < self._paddle_x + PADDLE_W)
+            if caught:
+                reward = 1.0
+            else:
+                self._lives -= 1
+                if self._lives <= 0:
+                    done = True
+            self._reset_round()
+            self._launched = True  # subsequent rounds auto-launch
+        return self._render(), reward, done, {}
+
+    def _render(self) -> np.ndarray:
+        frame = np.zeros((H, W, 3), np.uint8)
+        frame[..., 2] = 30  # background
+        # Flicker: the ball renders only on even frames (real ALE games
+        # alternate sprites; MaxAndSkipEnv's 2-frame max removes this).
+        if not self.flicker or self._frame_no % 2 == 0:
+            y = min(self._ball_y, H - BALL)
+            frame[y:y + BALL,
+                  self._ball_x:self._ball_x + BALL] = (236, 236, 64)
+        frame[H - 8:H - 4,
+              self._paddle_x:self._paddle_x + PADDLE_W] = (200, 72, 72)
+        return frame

@@ -1,0 +1,363 @@
+"""DeepMind-style Atari preprocessing on the in-repo Env interface.
+
+Parity: `rllib/env/atari_wrappers.py:1` — the exact preprocessing stack
+the reference's Atari baselines assume: noop starts, 4-frame max-pool
+skip, episodic lives, fire-on-reset, 84x84 grayscale warp, 4-frame
+stacking, sign reward clipping. Re-implemented against this framework's
+4-tuple `Env` interface (works on `GymEnv`-adapted ALE envs and on any
+in-repo env exposing the same `ale`-style hooks).
+
+Two deliberate departures, both TPU-motivated:
+- `wrap_deepmind(..., framestack="device")` stops at the single warped
+  frame and marks the env for ON-DEVICE stacking
+  (`device_frame_stack.py`): the host ships one [84, 84, 1] frame per
+  step and the stack lives in HBM — 4x less host->device traffic than
+  the reference's host-side stack.
+- Frame warping uses cv2 when importable (same INTER_AREA path as the
+  reference) with a numpy area-mean fallback, so the stack has no hard
+  cv2 dependency.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+
+import numpy as np
+
+from .env import Env
+from .spaces import Box
+
+try:
+    import cv2
+    cv2.ocl.setUseOpenCL(False)
+    _HAVE_CV2 = True
+except ImportError:  # pragma: no cover - cv2 is in the base image
+    _HAVE_CV2 = False
+
+
+def is_atari(env) -> bool:
+    """Reference heuristic (`atari_wrappers.py:9`): image obs + an ALE
+    handle on the unwrapped env."""
+    shape = getattr(getattr(env, "observation_space", None), "shape", None)
+    if shape is None or len(shape) <= 2:
+        return False
+    return _ale(env) is not None
+
+
+def _unwrapped(env):
+    base = env
+    while True:
+        if hasattr(base, "gym_env"):  # GymEnv adapter
+            base = base.gym_env
+        elif hasattr(base, "unwrapped") and base.unwrapped is not base:
+            base = base.unwrapped
+        elif hasattr(base, "env"):  # wrapper chains (ours + gym's)
+            base = base.env
+        else:
+            return base
+
+
+def _ale(env):
+    return getattr(_unwrapped(env), "ale", None)
+
+
+def _action_meanings(env):
+    base = _unwrapped(env)
+    get = getattr(base, "get_action_meanings", None)
+    return get() if get is not None else []
+
+
+def get_wrapper_by_cls(env, cls):
+    """Walk the wrapper chain looking for `cls` (reference
+    `atari_wrappers.py:17`)."""
+    cur = env
+    while cur is not None:
+        if isinstance(cur, cls):
+            return cur
+        cur = getattr(cur, "env", None)
+    return None
+
+
+class Wrapper(Env):
+    """Minimal wrapper base for the 4-tuple Env interface."""
+
+    def __init__(self, env):
+        self.env = env
+        self.observation_space = env.observation_space
+        self.action_space = env.action_space
+
+    def reset(self):
+        return self.env.reset()
+
+    def step(self, action):
+        return self.env.step(action)
+
+    def seed(self, seed=None):
+        self.env.seed(seed)
+
+    def close(self):
+        self.env.close()
+
+
+class MonitorEnv(Wrapper):
+    """Record true episode stats BELOW EpisodicLifeEnv etc., so reported
+    rewards are per game, not per life (reference `MonitorEnv:29`)."""
+
+    def __init__(self, env):
+        super().__init__(env)
+        self._current_reward = None
+        self._num_steps = None
+        self._total_steps = 0
+        self._episode_rewards = []
+        self._episode_lengths = []
+        self._num_returned = 0
+
+    def reset(self):
+        obs = self.env.reset()
+        if self._current_reward is not None:
+            self._episode_rewards.append(self._current_reward)
+            self._episode_lengths.append(self._num_steps)
+        self._current_reward = 0.0
+        self._num_steps = 0
+        return obs
+
+    def step(self, action):
+        obs, rew, done, info = self.env.step(action)
+        self._current_reward += rew
+        self._num_steps += 1
+        self._total_steps += 1
+        return obs, rew, done, info
+
+    def get_episode_rewards(self):
+        return self._episode_rewards
+
+    def get_episode_lengths(self):
+        return self._episode_lengths
+
+    def get_total_steps(self):
+        return self._total_steps
+
+    def next_episode_results(self):
+        for i in range(self._num_returned, len(self._episode_rewards)):
+            yield (self._episode_rewards[i], self._episode_lengths[i])
+        self._num_returned = len(self._episode_rewards)
+
+
+class NoopResetEnv(Wrapper):
+    """Random number of no-ops after reset (reference `NoopResetEnv:78`)."""
+
+    def __init__(self, env, noop_max: int = 30):
+        super().__init__(env)
+        self.noop_max = noop_max
+        self.override_num_noops = None
+        self.noop_action = 0
+        meanings = _action_meanings(env)
+        assert not meanings or meanings[0] == "NOOP"
+        self._rng = np.random.default_rng()
+
+    def seed(self, seed=None):
+        self._rng = np.random.default_rng(seed)
+        self.env.seed(seed)
+
+    def reset(self):
+        obs = self.env.reset()
+        noops = self.override_num_noops
+        if noops is None:
+            noops = int(self._rng.integers(1, self.noop_max + 1))
+        for _ in range(noops):
+            obs, _, done, _ = self.env.step(self.noop_action)
+            if done:
+                obs = self.env.reset()
+        return obs
+
+
+class ClipRewardEnv(Wrapper):
+    """Sign-clip rewards to {-1, 0, 1} (reference `ClipRewardEnv:107`)."""
+
+    def step(self, action):
+        obs, rew, done, info = self.env.step(action)
+        return obs, float(np.sign(rew)), done, info
+
+
+class FireResetEnv(Wrapper):
+    """Press FIRE after reset for fixed-until-firing games (reference
+    `FireResetEnv:118`)."""
+
+    def __init__(self, env):
+        super().__init__(env)
+        meanings = _action_meanings(env)
+        assert meanings[1] == "FIRE" and len(meanings) >= 3
+
+    def reset(self):
+        self.env.reset()
+        obs, _, done, _ = self.env.step(1)
+        if done:
+            self.env.reset()
+        obs, _, done, _ = self.env.step(2)
+        if done:
+            self.env.reset()
+        return obs
+
+
+class EpisodicLifeEnv(Wrapper):
+    """Life loss ends the episode; full reset only on true game over
+    (reference `EpisodicLifeEnv:141`)."""
+
+    def __init__(self, env):
+        super().__init__(env)
+        self.lives = 0
+        self.was_real_done = True
+
+    def step(self, action):
+        obs, reward, done, info = self.env.step(action)
+        self.was_real_done = done
+        lives = _ale(self.env).lives()
+        if 0 < lives < self.lives:
+            done = True
+        self.lives = lives
+        return obs, reward, done, info
+
+    def reset(self):
+        if self.was_real_done:
+            obs = self.env.reset()
+        else:
+            # No-op step advances past the lost-life state.
+            obs, _, _, _ = self.env.step(0)
+        self.lives = _ale(self.env).lives()
+        return obs
+
+
+class MaxAndSkipEnv(Wrapper):
+    """Repeat the action `skip` times; observe the max of the last two
+    raw frames (flicker removal, reference `MaxAndSkipEnv:178`)."""
+
+    def __init__(self, env, skip: int = 4):
+        super().__init__(env)
+        self._obs_buffer = np.zeros(
+            (2,) + tuple(env.observation_space.shape), dtype=np.uint8)
+        self._skip = skip
+
+    def step(self, action):
+        total_reward = 0.0
+        done = False
+        info = {}
+        for i in range(self._skip):
+            obs, reward, done, info = self.env.step(action)
+            if i == self._skip - 2:
+                self._obs_buffer[0] = obs
+            if i == self._skip - 1:
+                self._obs_buffer[1] = obs
+            total_reward += reward
+            if done:
+                break
+        return (self._obs_buffer.max(axis=0), total_reward, done, info)
+
+
+def _warp(frame: np.ndarray, dim: int) -> np.ndarray:
+    """RGB -> grayscale -> [dim, dim, 1] uint8."""
+    if frame.ndim == 3 and frame.shape[-1] == 3:
+        if _HAVE_CV2:
+            gray = cv2.cvtColor(frame, cv2.COLOR_RGB2GRAY)
+        else:
+            gray = (frame @ np.array([0.299, 0.587, 0.114])).astype(
+                np.uint8)
+    else:
+        gray = frame.reshape(frame.shape[:2])
+    if gray.shape != (dim, dim):
+        if _HAVE_CV2:
+            gray = cv2.resize(gray, (dim, dim),
+                              interpolation=cv2.INTER_AREA)
+        else:
+            h, w = gray.shape
+            ys = (np.arange(dim) * h // dim)
+            xs = (np.arange(dim) * w // dim)
+            gray = gray[ys][:, xs]
+    return gray[:, :, None]
+
+
+class WarpFrame(Wrapper):
+    """Warp to [dim, dim, 1] grayscale (reference `WarpFrame:209`)."""
+
+    def __init__(self, env, dim: int = 84):
+        super().__init__(env)
+        self.dim = dim
+        self.observation_space = Box(
+            low=0, high=255, shape=(dim, dim, 1), dtype=np.uint8)
+
+    def reset(self):
+        return _warp(self.env.reset(), self.dim)
+
+    def step(self, action):
+        obs, rew, done, info = self.env.step(action)
+        return _warp(obs, self.dim), rew, done, info
+
+
+class FrameStack(Wrapper):
+    """Host-side k-frame stack on the channel axis (reference
+    `FrameStack:230`). Prefer framestack="device" in `wrap_deepmind`
+    for the TPU inline-actor path."""
+
+    def __init__(self, env, k: int):
+        super().__init__(env)
+        self.k = k
+        self.frames = deque([], maxlen=k)
+        shp = env.observation_space.shape
+        self.observation_space = Box(
+            low=0, high=255, shape=(shp[0], shp[1], shp[2] * k),
+            dtype=env.observation_space.dtype)
+
+    def reset(self):
+        ob = self.env.reset()
+        for _ in range(self.k):
+            self.frames.append(ob)
+        return self._get_ob()
+
+    def step(self, action):
+        ob, reward, done, info = self.env.step(action)
+        self.frames.append(ob)
+        return self._get_ob(), reward, done, info
+
+    def _get_ob(self):
+        assert len(self.frames) == self.k
+        return np.concatenate(self.frames, axis=2)
+
+
+class ScaledFloatFrame(Wrapper):
+    """uint8 -> [0, 1] float32 (reference `ScaledFloatFrame:259`). The
+    in-repo networks normalize uint8 on-device, so this is only for
+    policies consuming raw floats."""
+
+    def __init__(self, env):
+        super().__init__(env)
+        shp = env.observation_space.shape
+        self.observation_space = Box(low=0.0, high=1.0, shape=shp,
+                                     dtype=np.float32)
+
+    def reset(self):
+        return np.asarray(self.env.reset(), np.float32) / 255.0
+
+    def step(self, action):
+        obs, rew, done, info = self.env.step(action)
+        return np.asarray(obs, np.float32) / 255.0, rew, done, info
+
+
+def wrap_deepmind(env, dim: int = 84, framestack=True):
+    """The reference's DeepMind preprocessing stack
+    (`atari_wrappers.py:271`), plus framestack="device": stop at the
+    warped single frame and mark the env for on-device stacking (pair
+    with trainer config `device_frame_stack: 4`)."""
+    env = MonitorEnv(env)
+    env = NoopResetEnv(env, noop_max=30)
+    spec_id = getattr(getattr(env, "spec", None), "id", "") or \
+        getattr(_unwrapped(env), "spec_id", "")
+    if "NoFrameskip" in str(spec_id):
+        env = MaxAndSkipEnv(env, skip=4)
+    env = EpisodicLifeEnv(env)
+    if "FIRE" in _action_meanings(env):
+        env = FireResetEnv(env)
+    env = WarpFrame(env, dim)
+    if framestack == "device":
+        env.device_frame_stack_ready = True
+    elif framestack:
+        env = FrameStack(env, 4)
+    return env

@@ -1,0 +1,89 @@
+"""Gymnasium adapter: run any installed gym env under this framework.
+
+Parity: the reference resolves env ids through `gym.make` directly
+(`rllib/agents/trainer.py` `_setup`, `rllib/env/atari_wrappers.py`
+operates on gym envs). This framework's internal Env interface is the
+classic 4-tuple (`env.py:Env`); gymnasium moved to
+`reset() -> (obs, info)` and 5-tuple steps (terminated/truncated), so
+the adapter folds those back: done = terminated | truncated, seeding
+via reset(seed=...).
+
+Resolution order for a string env id (`registry.make_env`):
+in-repo registry first (exact behavioral control for the envs tests
+depend on), then gymnasium if installed. `GymEnv` can also wrap an
+already-constructed gymnasium env (e.g. one wrapped by
+`atari_wrappers.wrap_deepmind`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from .env import Env
+from .spaces import Box, Discrete
+
+
+def have_gymnasium() -> bool:
+    try:
+        import gymnasium  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+def convert_space(space):
+    """gymnasium space -> in-repo space (Box/Discrete)."""
+    import gymnasium
+    if isinstance(space, gymnasium.spaces.Box):
+        return Box(low=space.low, high=space.high, shape=space.shape,
+                   dtype=space.dtype)
+    if isinstance(space, gymnasium.spaces.Discrete):
+        return Discrete(int(space.n))
+    raise ValueError(
+        f"unsupported gymnasium space {type(space).__name__}; only "
+        "Box and Discrete translate to the in-repo space vocabulary")
+
+
+class GymEnv(Env):
+    """A gymnasium env behind the in-repo Env interface."""
+
+    def __init__(self, env, seed: Optional[int] = None):
+        self.gym_env = env
+        self.observation_space = convert_space(env.observation_space)
+        self.action_space = convert_space(env.action_space)
+        self._seed = seed
+        self._needs_seed = seed is not None
+
+    @classmethod
+    def make(cls, env_id: str, env_config: dict = None) -> "GymEnv":
+        import gymnasium
+        cfg = dict(env_config or {})
+        seed = cfg.pop("seed", None)
+        cfg.pop("worker_index", None)  # registry plumbing, not a kwarg
+        return cls(gymnasium.make(env_id, **cfg), seed=seed)
+
+    def reset(self):
+        if self._needs_seed:
+            self._needs_seed = False
+            obs, _ = self.gym_env.reset(seed=self._seed)
+        else:
+            obs, _ = self.gym_env.reset()
+        return np.asarray(obs)
+
+    def step(self, action):
+        if isinstance(self.action_space, Discrete):
+            action = int(np.asarray(action).reshape(()))
+        obs, reward, terminated, truncated, info = self.gym_env.step(
+            action)
+        return (np.asarray(obs), float(reward),
+                bool(terminated or truncated), info)
+
+    def seed(self, seed=None):
+        # gymnasium seeds through reset(); remember it for the next one.
+        self._seed = seed
+        self._needs_seed = seed is not None
+
+    def close(self):
+        self.gym_env.close()

@@ -1,0 +1,82 @@
+"""Learning-curve regression driver.
+
+Parity: `rllib/tests/run_regression_tests.py:1` — each yaml in
+`tuned_examples/regression_tests/` declares an algorithm + env + an
+`episode_reward_mean` stop target; a config regresses when training no
+longer reaches its target. Runs each experiment through
+`tune.run_experiments` with up to 3 retries (same flake policy as the
+reference).
+
+Usage:
+    python -m ray_tpu.rllib.run_regression_tests [yaml ...]
+    python -m ray_tpu.rllib.run_regression_tests          # whole dir
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import os
+import sys
+
+import yaml
+
+import ray_tpu
+from ray_tpu.tune import run_experiments
+
+REGRESSION_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    "tuned_examples", "regression_tests")
+
+
+def run_one(path: str, retries: int = 3) -> bool:
+    """True iff every trial reaches its episode_reward_mean target
+    within `retries` attempts."""
+    with open(path) as f:
+        experiments = yaml.safe_load(f)
+    print(f"== Regression test {os.path.basename(path)} ==")
+    for attempt in range(retries):
+        analysis = run_experiments(experiments)
+        failures = 0
+        for t in analysis.trials:
+            target = (t.stopping_criterion or {}).get(
+                "episode_reward_mean")
+            got = (t.last_result or {}).get(
+                "episode_reward_mean", float("-inf"))
+            if target is not None and not got >= target:
+                failures += 1
+                print(f"  trial {t}: reward {got:.1f} < target {target}")
+        if not failures:
+            print(f"  PASSED (attempt {attempt + 1})")
+            return True
+        print(f"  flaked, retry {attempt + 1}")
+    print("  FAILED")
+    return False
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("yamls", nargs="*",
+                        help="regression yamls (default: the whole "
+                             "regression_tests directory)")
+    parser.add_argument("--retries", type=int, default=3)
+    args = parser.parse_args(argv)
+    paths = args.yamls or sorted(
+        glob.glob(os.path.join(REGRESSION_DIR, "*.yaml")))
+    if not paths:
+        print("no regression yamls found", file=sys.stderr)
+        return 2
+    ray_tpu.init()
+    try:
+        failed = [p for p in paths if not run_one(p, args.retries)]
+    finally:
+        ray_tpu.shutdown()
+    if failed:
+        print("FAILED:", ", ".join(os.path.basename(p) for p in failed))
+        return 1
+    print(f"all {len(paths)} regression tests passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

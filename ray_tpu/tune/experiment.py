@@ -53,6 +53,11 @@ class Experiment:
         `tune/config_parser.py` + `Experiment.from_json`)."""
         spec = dict(spec)
         run = spec.pop("run")
+        if "env" in spec:
+            # yaml specs put env at top level (reference convention,
+            # `tune/config_parser.py`); fold into config.
+            spec["config"] = dict(spec.get("config") or {})
+            spec["config"].setdefault("env", spec.pop("env"))
         return cls(
             name=name,
             run=run,

@@ -92,10 +92,25 @@ class TPESearcher(Searcher):
         # ignoring data).
         return [o for obs in self._obs.values() for o in obs]
 
+    def _seeded_sample(self, dom):
+        """Draw from a Domain with THIS searcher's rng. `dom.sample`
+        uses stdlib random's global state, which would make a seeded
+        TPESearcher non-reproducible during warmup."""
+        if isinstance(dom, Choice):
+            return dom.options[self._rng.integers(len(dom.options))]
+        if isinstance(dom, RandInt):
+            return int(self._rng.integers(dom.low, dom.high))
+        if isinstance(dom, LogUniform):
+            return float(np.exp(self._rng.uniform(
+                math.log(dom.low), math.log(dom.high))))
+        if isinstance(dom, Uniform):
+            return float(self._rng.uniform(dom.low, dom.high))
+        return dom.sample(None)  # custom sample_from: only path left
+
     def suggest(self, trial_id: str) -> Optional[Dict[str, object]]:
         obs = self._training_set()
         if len(obs) < self.n_initial:
-            cfg = {name: dom.sample(None)
+            cfg = {name: self._seeded_sample(dom)
                    for name, dom in self.space.items()}
         else:
             cfg = self._suggest_tpe(obs)

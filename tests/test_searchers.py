@@ -55,15 +55,16 @@ def _random_best(seed: int, n: int) -> float:
 
 class TestTPE:
     def test_tpe_beats_random_on_quadratic(self):
-        """24-trial budget, 12 seeds: TPE's mean best loss must beat
+        """24-trial budget, 40 seeds (deterministic since the searcher
+        seeds its own warmup draws): TPE's mean best loss must beat
         random's by a clear margin and win most head-to-heads."""
-        seeds = range(12)
+        seeds = range(40)
         tpe = [_tpe_best(s, 24) for s in seeds]
         rnd = [_random_best(s, 24) for s in seeds]
         assert np.mean(tpe) < 0.8 * np.mean(rnd), (np.mean(tpe),
                                                    np.mean(rnd))
         wins = sum(t < r for t, r in zip(tpe, rnd))
-        assert wins >= 7, (wins, tpe, rnd)
+        assert wins >= 24, (wins, tpe, rnd)
 
     def test_maximize_mode(self):
         s = TPESearcher(metric="score", mode="max", n_initial=6, seed=0)
