@@ -53,6 +53,9 @@ class ActorInfo:
         self.worker_pid: Optional[int] = None
         self.restarts_left = spec.max_restarts
         self.death_reason: str = ""
+        # Checkpointable actors (parity: GCS ActorCheckpointIdData,
+        # `src/ray/gcs/tables.h:777`): newest-first (id, timestamp).
+        self.checkpoints: list = []
 
     def view(self) -> dict:
         return {
@@ -155,6 +158,10 @@ class HeadServer:
         # process, SIGSTOP) — is declared dead after the timeout.
         self._heartbeat_timeout = float(
             os.environ.get("RAY_TPU_HEARTBEAT_TIMEOUT_S", "30"))
+        # Checkpoint ids kept per Checkpointable actor (parity:
+        # `ray_config_def.h` num_actor_checkpoints_to_keep).
+        self._num_actor_checkpoints_to_keep = int(
+            os.environ.get("RAY_TPU_NUM_ACTOR_CHECKPOINTS_TO_KEEP", "20"))
         # Per-process metric snapshots pushed by workers/drivers
         # (addr -> {"node":, "counters":, "gauges":}).
         self._metric_snaps: Dict[str, dict] = {}
@@ -616,6 +623,28 @@ class HeadServer:
                                                     clear_task=True)
             view = info.view()
         self._publish("actor:" + actor_id.hex(), view)
+
+    def _h_actor_checkpoint_saved(self, conn, msg):
+        """Register a checkpoint id; reply with ids that fell off the
+        keep-window so the actor can delete their payloads
+        (parity: `tables.h:777` + num_actor_checkpoints_to_keep)."""
+        import time as _time
+        with self._lock:
+            info = self._actors.get(msg["actor_id"])
+            expired = []
+            if info is not None:
+                info.checkpoints.insert(
+                    0, (msg["checkpoint_id"], _time.time()))
+                keep = self._num_actor_checkpoints_to_keep
+                expired = [cid for cid, _ in info.checkpoints[keep:]]
+                del info.checkpoints[keep:]
+        conn.reply(msg, expired=expired)
+
+    def _h_get_actor_checkpoints(self, conn, msg):
+        with self._lock:
+            info = self._actors.get(msg["actor_id"])
+            cps = list(info.checkpoints) if info is not None else []
+        conn.reply(msg, checkpoints=cps)
 
     def _h_actor_creation_failed(self, conn, msg):
         actor_id: ActorID = msg["actor_id"]

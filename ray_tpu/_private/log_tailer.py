@@ -69,14 +69,19 @@ class LogTailer(threading.Thread):
                 # `continue` would wedge this file's tailing forever).
                 # Back off to a UTF-8 character boundary so a multi-byte
                 # char split at MAX_CHUNK isn't mangled across shipments.
+                # A valid split strips at most 3 continuation bytes +
+                # 1 lead byte; more means non-UTF-8 (binary) content —
+                # ship it raw rather than re-wedging the offset.
                 if len(chunk) < MAX_CHUNK:
                     continue
-                while chunk and (chunk[-1] & 0xC0) == 0x80:
-                    chunk = chunk[:-1]
-                if chunk and chunk[-1] >= 0xC0:  # orphaned lead byte
-                    chunk = chunk[:-1]
-                if not chunk:
-                    continue
+                trimmed = chunk
+                for _ in range(3):
+                    if trimmed and (trimmed[-1] & 0xC0) == 0x80:
+                        trimmed = trimmed[:-1]
+                if trimmed and trimmed[-1] >= 0xC0:  # orphaned lead byte
+                    trimmed = trimmed[:-1]
+                if trimmed and (trimmed[-1] & 0xC0) != 0x80:
+                    chunk = trimmed
             else:
                 chunk = chunk[:cut + 1]
             self._offsets[path] = offset + len(chunk)

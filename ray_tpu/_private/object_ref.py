@@ -8,6 +8,8 @@ address in the ref so any borrower can dial the owner directly.
 
 from __future__ import annotations
 
+import threading
+
 from .ids import ObjectID
 
 # Process-global reference tracker, installed by the Runtime. Every
@@ -17,10 +19,28 @@ from .ids import ObjectID
 # `src/ray/core_worker/reference_count.h`).
 _tracker = None
 
+# Per-thread export collection: while a protocol send is pickling a
+# message, every owned ref reduced into it is recorded here so the send
+# site can pin (oid, destination) until the borrower's add_borrow is
+# acknowledged (parity: reference_count.h borrower bookkeeping). Outside
+# a collection (user pickling a ref to disk etc.) __reduce__ falls back
+# to the wall-clock export grace.
+_export_ctx = threading.local()
+
 
 def set_ref_tracker(tracker) -> None:
     global _tracker
     _tracker = tracker
+
+
+def begin_export_collection() -> None:
+    _export_ctx.items = []
+
+
+def end_export_collection() -> list:
+    items = getattr(_export_ctx, "items", None)
+    _export_ctx.items = None
+    return items or []
 
 
 class ObjectRef:
@@ -58,15 +78,39 @@ class ObjectRef:
 
     def __reduce__(self):
         # Pickling a ref we own means a peer may be about to borrow it;
-        # tell the tracker so eviction waits for the borrow to register.
-        if _tracker is not None:
+        # record it so eviction waits for the borrow to register.
+        items = getattr(_export_ctx, "items", None)
+        if items is not None:
+            items.append((self.id, self.owner_addr))
+        elif _tracker is not None:
             try:
                 _tracker.note_export(self.id, self.owner_addr)
             except Exception:
                 pass
-        return (ObjectRef, (self.id, self.owner_addr, self.size_hint))
+        return (_deserialize_ref, (self.id, self.owner_addr,
+                                   self.size_hint))
 
     # Keep users from iterating a ref thinking it's the value.
     def __iter__(self):
         raise TypeError(
             "ObjectRef is not iterable; call ray_tpu.get(ref) first.")
+
+
+def _deserialize_ref(oid: ObjectID, owner_addr: str,
+                     size_hint: int) -> ObjectRef:
+    """Unpickle entry point for ObjectRef: constructs the ref (incref ->
+    add_borrow on the 0->1 transition, via __init__) and acknowledges
+    THIS delivered copy to the owner. Every exported copy is pinned
+    owner-side until its ack arrives (see runtime._export_pins) — the
+    add_borrow alone can't serve as the ack because only the first copy
+    a process deserializes triggers one. The add_borrow (when any) is
+    enqueued by __init__ BEFORE the ack, and the notify queue is FIFO
+    per owner, so the owner always registers the borrow before it
+    releases the pin."""
+    ref = ObjectRef(oid, owner_addr, size_hint)
+    if _tracker is not None:
+        try:
+            _tracker.ack_export(oid, owner_addr)
+        except Exception:
+            pass
+    return ref

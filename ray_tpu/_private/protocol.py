@@ -33,6 +33,18 @@ PICKLE_PROTOCOL = 5
 
 TCP_PREFIX = "tcp://"
 
+# Optional (begin_fn, finish_fn) installed by the runtime: begin_fn()
+# runs before a message is pickled, finish_fn(peer_addr) after — used to
+# pin owned ObjectRefs exported in the message to their destination
+# until the borrower acknowledges (see runtime._register_export_pins).
+_serialize_hooks = None
+
+
+def set_serialize_hooks(begin_fn: Optional[Callable],
+                        finish_fn: Optional[Callable]) -> None:
+    global _serialize_hooks
+    _serialize_hooks = (begin_fn, finish_fn) if begin_fn else None
+
 
 def is_tcp(addr: str) -> bool:
     return addr.startswith(TCP_PREFIX)
@@ -127,7 +139,15 @@ class Connection:
 
     # -- sending ---------------------------------------------------------
     def send(self, msg: dict) -> None:
-        payload = pickle.dumps(msg, protocol=PICKLE_PROTOCOL)
+        hooks = _serialize_hooks
+        if hooks is not None:
+            hooks[0]()
+            try:
+                payload = pickle.dumps(msg, protocol=PICKLE_PROTOCOL)
+            finally:
+                hooks[1](self.peer_addr)
+        else:
+            payload = pickle.dumps(msg, protocol=PICKLE_PROTOCOL)
         try:
             with self._send_lock:
                 _send_msg(self.sock, payload)

@@ -8,6 +8,7 @@ asyncio actors, and `max_restarts` fault tolerance.
 
 from __future__ import annotations
 
+import collections
 import hashlib
 import inspect
 from typing import Dict, Optional
@@ -171,6 +172,42 @@ class ActorClass:
         raise TypeError(
             f"Actor class '{self._class_name}' cannot be instantiated "
             f"directly; use '{self._class_name}.remote()'.")
+
+
+Checkpoint = collections.namedtuple(
+    "Checkpoint", ["checkpoint_id", "timestamp"])
+
+CheckpointContext = collections.namedtuple(
+    "CheckpointContext",
+    ["actor_id", "num_tasks_since_last_checkpoint",
+     "last_checkpoint_id", "last_checkpoint_timestamp"])
+
+
+class Checkpointable:
+    """An actor that can checkpoint/restore its state across restarts.
+
+    Parity: `python/ray/actor.py:866` (Checkpointable) + the GCS actor
+    checkpoint table (`src/ray/gcs/tables.h:777`). After every task the
+    runtime calls `should_checkpoint(context)`; on True it assigns a
+    checkpoint id, calls `save_checkpoint`, and registers the id with
+    the head (which keeps the most recent K and reports expired ids
+    back through `checkpoint_expired`). When a killed actor restarts,
+    `load_checkpoint(actor_id, available_checkpoints)` runs AFTER
+    `__init__` so the instance can restore state instead of starting
+    from the bare creation replay.
+    """
+
+    def should_checkpoint(self, checkpoint_context: CheckpointContext):
+        raise NotImplementedError
+
+    def save_checkpoint(self, actor_id, checkpoint_id):
+        raise NotImplementedError
+
+    def load_checkpoint(self, actor_id, available_checkpoints):
+        raise NotImplementedError
+
+    def checkpoint_expired(self, actor_id, checkpoint_id):
+        raise NotImplementedError
 
 
 def get_actor(name: str) -> ActorHandle:

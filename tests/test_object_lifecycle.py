@@ -75,6 +75,99 @@ class TestEviction:
         assert not rt.shm.contains(oid)
 
 
+class TestExportPins:
+    """Acknowledged-borrow protocol (r4, replaces the r3 wall-clock
+    grace): an owned ref exported through a protocol send stays pinned
+    until the recipient's add_borrow arrives — no matter how delayed —
+    or the recipient's connection dies."""
+
+    def _export_via_protocol(self, rt, ref, peer="fake-peer-addr"):
+        """Simulate pickling `ref` inside a protocol send to `peer`."""
+        from ray_tpu._private import object_ref as oref
+        oref.begin_export_collection()
+        import pickle
+        pickle.dumps(ref)
+        rt._finish_export_collection(peer)
+
+    def test_pin_survives_beyond_old_grace(self, small_store_ray,
+                                           monkeypatch):
+        ray = small_store_ray
+        rt = ray._private.worker_state.get_runtime()
+        # Old-grace regression setup: a borrower whose add_borrow lands
+        # after the grace window. With pins, eviction must still wait.
+        monkeypatch.setattr(rt, "_eviction_grace", 0.05)
+        ref = ray.put(np.zeros(1 << 18))  # 2 MB
+        oid = ref.id
+        self._export_via_protocol(rt, ref)
+        del ref
+        gc.collect()
+        import time
+        time.sleep(0.2)  # well past the (shrunk) wall-clock grace
+        # Pressure the store: pinned object must survive eviction.
+        for _ in range(5):
+            r = ray.put(np.zeros(1 << 18))
+            del r
+            gc.collect()
+        assert rt.shm.contains(oid), \
+            "exported object evicted before its borrow was acknowledged"
+        # The (delayed) acknowledgement arrives; borrow registered.
+        with rt._owned_lock:
+            rt._borrows[oid] = rt._borrows.get(oid, 0) + 1
+            rt._consume_export_pin(oid, "fake-peer-addr")
+        assert oid not in rt._export_pins
+        # Borrow released -> object becomes evictable again.
+        with rt._owned_lock:
+            rt._borrows.pop(oid, None)
+        for _ in range(5):
+            r = ray.put(np.zeros(1 << 18))
+            del r
+            gc.collect()
+        assert not rt.shm.contains(oid)
+
+    def test_peer_death_releases_pin(self, small_store_ray, monkeypatch):
+        ray = small_store_ray
+        rt = ray._private.worker_state.get_runtime()
+        monkeypatch.setattr(rt, "_eviction_grace", 0.05)
+        ref = ray.put(np.zeros(1 << 18))
+        oid = ref.id
+        self._export_via_protocol(rt, ref, peer="dead-peer")
+        del ref
+        gc.collect()
+        import time
+        time.sleep(0.1)
+        rt._drop_peer_pins("dead-peer")
+        for _ in range(5):
+            r = ray.put(np.zeros(1 << 18))
+            del r
+            gc.collect()
+        assert not rt.shm.contains(oid)
+
+    def test_real_task_arg_pins_and_releases(self, small_store_ray):
+        """End to end: a ref passed as a task arg is pinned at send and
+        released once the worker's borrow registers + drops."""
+        ray = small_store_ray
+        rt = ray._private.worker_state.get_runtime()
+
+        @ray.remote
+        def consume(x):
+            return float(np.sum(x[:4]))
+
+        ref = ray.put(np.ones(1 << 18))
+        out = ray.get(consume.remote(ref))
+        assert out == 4.0
+        # After completion the worker's remove_borrow eventually lands;
+        # pins must not accumulate indefinitely.
+        import time
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with rt._owned_lock:
+                if ref.id not in rt._export_pins:
+                    break
+            time.sleep(0.1)
+        with rt._owned_lock:
+            assert ref.id not in rt._export_pins
+
+
 class TestBorrows:
     def test_worker_borrow_blocks_eviction(self, small_store_ray):
         """An object borrowed by a live actor must not evict even after
