@@ -28,6 +28,7 @@ Public surface (parity: `python/ray/__init__.py` + `worker.py`):
 from __future__ import annotations
 
 import inspect as _inspect
+import os as _os
 from typing import Optional as _Optional
 
 from . import exceptions
@@ -66,6 +67,10 @@ def init(num_cpus: _Optional[float] = None,
     if _ws.get_runtime_or_none() is not None:
         raise RuntimeError("ray_tpu.init() called twice; call "
                            "ray_tpu.shutdown() first")
+    if address is None:
+        # `ray_tpu.scripts exec` injects the cluster address (parity:
+        # `ray exec` / RAY_ADDRESS).
+        address = _os.environ.get("RAY_TPU_ADDRESS") or None
     if local_mode:
         from ._private.local_mode import LocalRuntime
         _LOCAL_RUNTIME = LocalRuntime()

@@ -1,0 +1,100 @@
+"""Dashboard-lite: one server-rendered HTML page on the head.
+
+Parity: `python/ray/dashboard/dashboard.py:91` — the reference ships an
+aiohttp + React app; this is the stdlib re-expression of its content
+(nodes, actors, in-flight tasks, store usage, recent errors, log tail)
+served from the head's existing metrics HTTP server at `/`. No build
+step, no sockets beyond the one ThreadingHTTPServer, auto-refresh via
+meta tag.
+"""
+
+from __future__ import annotations
+
+import html
+import time
+
+_PAGE = """<!doctype html>
+<html><head><title>ray_tpu dashboard</title>
+<meta http-equiv="refresh" content="5">
+<style>
+body {{ font-family: monospace; margin: 1.5em; background: #fafafa; }}
+h1 {{ font-size: 1.3em; }} h2 {{ font-size: 1.05em; margin-top: 1.4em; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #bbb; padding: 3px 9px; text-align: left; }}
+th {{ background: #eee; }}
+pre {{ background: #111; color: #ddd; padding: 8px; max-height: 20em;
+      overflow-y: auto; }}
+.dead {{ color: #b00; }} .alive {{ color: #070; }}
+</style></head><body>
+<h1>ray_tpu — session {session}</h1>
+<p>{now} &middot; {n_nodes} node(s) &middot; {n_actors} actor(s)
+&middot; {inflight} in-flight task(s) &middot; {pending} queued</p>
+<h2>Nodes</h2>{nodes}
+<h2>Actors</h2>{actors}
+<h2>Object store</h2>{store}
+<h2>Recent errors</h2><pre>{errors}</pre>
+<h2>Log tail</h2><pre>{logs}</pre>
+</body></html>"""
+
+
+def _table(headers, rows) -> str:
+    if not rows:
+        return "<p>(none)</p>"
+    head_cells = "".join(f"<th>{html.escape(h)}</th>" for h in headers)
+    body = "".join(
+        "<tr>" + "".join(f"<td>{c}</td>" for c in row) + "</tr>"
+        for row in rows)
+    return f"<table><tr>{head_cells}</tr>{body}</table>"
+
+
+def _fmt_res(res: dict) -> str:
+    return html.escape(", ".join(
+        f"{k}: {v:g}" for k, v in sorted(res.items()))) or "-"
+
+
+def render(head) -> str:
+    """Build the page from a HeadServer's live state."""
+    with head._lock:
+        nodes = [n.view() for n in head._nodes.values()]
+        actors = [i.view() for i in head._actors.values()]
+        inflight = len(head._inflight)
+        pending = len(head._pending)
+        errors = list(head._recent_errors)
+        logs = list(head._recent_logs)
+    agg = head._aggregated_metrics()
+    store_rows = [
+        (html.escape(k), f"{v:g}") for k, v in sorted(
+            agg.get("gauges", {}).items())
+        if "store" in k or "memory" in k or "object" in k]
+
+    node_rows = [(
+        html.escape(n["node_id"]),
+        f'<span class="{"alive" if n["alive"] else "dead"}">'
+        f'{"ALIVE" if n["alive"] else "DEAD"}</span>',
+        _fmt_res(n["total_resources"]),
+        _fmt_res(n["available_resources"]),
+    ) for n in nodes]
+    actor_rows = [(
+        n["actor_id"].hex()[:12] if hasattr(n["actor_id"], "hex")
+        else html.escape(str(n["actor_id"])),
+        html.escape(n.get("name") or "-"),
+        f'<span class="{"alive" if n["state"] == "ALIVE" else "dead"}">'
+        f'{html.escape(n["state"])}</span>',
+        html.escape(str(n.get("restarts_left"))),
+        html.escape(n.get("death_reason") or "-"),
+    ) for n in actors]
+
+    return _PAGE.format(
+        session=html.escape(head.session_name),
+        now=time.strftime("%Y-%m-%d %H:%M:%S"),
+        n_nodes=len(nodes), n_actors=len(actors),
+        inflight=inflight, pending=pending,
+        nodes=_table(
+            ("node", "state", "total", "available"), node_rows),
+        actors=_table(
+            ("actor", "name", "state", "restarts left", "death reason"),
+            actor_rows),
+        store=_table(("gauge", "value"), store_rows),
+        errors=html.escape("\n".join(errors) or "(none)"),
+        logs=html.escape("\n".join(logs) or "(none)"),
+    )

@@ -37,7 +37,7 @@ from typing import Dict, List, Optional, Set
 from ..exceptions import ActorDiedError, WorkerCrashedError
 from .ids import ActorID, TaskID
 from .task_spec import ACTOR_CREATION_TASK, TaskSpec
-from . import protocol
+from . import config, protocol
 
 logger = logging.getLogger(__name__)
 
@@ -156,12 +156,15 @@ class HeadServer:
         # `raylet/monitor.cc`): agents heartbeat into the head; a node
         # whose beats stop — even with a live TCP connection (wedged
         # process, SIGSTOP) — is declared dead after the timeout.
-        self._heartbeat_timeout = float(
-            os.environ.get("RAY_TPU_HEARTBEAT_TIMEOUT_S", "30"))
+        self._heartbeat_timeout = config.get(
+            "RAY_TPU_HEARTBEAT_TIMEOUT_S")
         # Checkpoint ids kept per Checkpointable actor (parity:
         # `ray_config_def.h` num_actor_checkpoints_to_keep).
-        self._num_actor_checkpoints_to_keep = int(
-            os.environ.get("RAY_TPU_NUM_ACTOR_CHECKPOINTS_TO_KEEP", "20"))
+        self._num_actor_checkpoints_to_keep = config.get(
+            "RAY_TPU_NUM_ACTOR_CHECKPOINTS_TO_KEEP")
+        # Dashboard ring buffers (dashboard.py): recent error/log tails.
+        self._recent_errors: deque = deque(maxlen=50)
+        self._recent_logs: deque = deque(maxlen=200)
         # Per-process metric snapshots pushed by workers/drivers
         # (addr -> {"node":, "counters":, "gauges":}).
         self._metric_snaps: Dict[str, dict] = {}
@@ -185,7 +188,7 @@ class HeadServer:
         # Worker-log tailing to the driver console (parity:
         # `python/ray/log_monitor.py:36` -> `worker.py:910`). The head
         # tails node0's log dir; node agents tail theirs.
-        if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+        if config.get("RAY_TPU_LOG_TO_DRIVER"):
             from .log_tailer import LogTailer
             self._log_tailer = LogTailer(
                 os.path.join(self.session_dir, "logs"), "node0",
@@ -193,7 +196,7 @@ class HeadServer:
             self._log_tailer.start()
         # Prometheus exposition (reference: `src/ray/stats/metric.h`'s
         # prometheus exposer, enabled in daemon mains).
-        port = int(os.environ.get("RAY_TPU_METRICS_PORT", "0") or 0)
+        port = config.get("RAY_TPU_METRICS_PORT")
         if port:
             self._start_metrics_http(port)
 
@@ -345,14 +348,21 @@ class HeadServer:
 
         class Handler(http.server.BaseHTTPRequestHandler):
             def do_GET(self):
-                agg = head._aggregated_metrics()
                 if self.path.startswith("/metrics.json"):
                     import json as _json
-                    body = _json.dumps(agg).encode()
+                    body = _json.dumps(
+                        head._aggregated_metrics()).encode()
                     ctype = "application/json"
-                else:
-                    body = metrics_mod.prometheus_text(agg).encode()
+                elif self.path.startswith("/metrics"):
+                    body = metrics_mod.prometheus_text(
+                        head._aggregated_metrics()).encode()
                     ctype = "text/plain; version=0.0.4"
+                else:
+                    # Dashboard-lite page (dashboard.py; parity:
+                    # `python/ray/dashboard/dashboard.py:91`).
+                    from .dashboard import render
+                    body = render(head).encode()
+                    ctype = "text/html; charset=utf-8"
                 self.send_response(200)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
@@ -375,6 +385,17 @@ class HeadServer:
                 # Driver consoles always receive error + log streams
                 # (parity: worker.py:910/:1006 listener threads).
                 subs |= self._drivers
+            # Dashboard ring buffers (dashboard.py): recent tails of
+            # every error/log stream flowing through the head.
+            if channel == "error":
+                self._recent_errors.append(str(data)[:500])
+            elif channel == "logs":
+                lines = data.get("lines", []) if isinstance(data, dict) \
+                    else [str(data)]
+                prefix = data.get("file", "") if isinstance(data, dict) \
+                    else ""
+                for line in lines:
+                    self._recent_logs.append(f"[{prefix}] {line}"[:300])
         for c in subs:
             try:
                 c.send({"kind": "publish", "channel": channel, "data": data})
@@ -623,6 +644,22 @@ class HeadServer:
                                                     clear_task=True)
             view = info.view()
         self._publish("actor:" + actor_id.hex(), view)
+
+    def cluster_load(self) -> dict:
+        """Autoscaler snapshot: per-node resource vectors + unplaceable
+        demand (parity: the load the reference's raylet heartbeats carry
+        to `monitor.py`, autoscaler.py:155)."""
+        with self._lock:
+            return {
+                "nodes": [n.view() for n in self._nodes.values()
+                          if n.alive],
+                "pending_tasks": len(self._pending),
+                "lease_queue_depth": sum(
+                    req[2] for req in self._lease_queue),
+            }
+
+    def _h_cluster_load(self, conn, msg):
+        conn.reply(msg, load=self.cluster_load())
 
     def _h_actor_checkpoint_saved(self, conn, msg):
         """Register a checkpoint id; reply with ids that fell off the

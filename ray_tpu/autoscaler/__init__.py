@@ -1,0 +1,3 @@
+from .autoscaler import StandardAutoscaler  # noqa: F401
+from .load_metrics import LoadMetrics  # noqa: F401
+from .node_provider import LocalNodeProvider, NodeProvider  # noqa: F401

@@ -1,0 +1,90 @@
+"""StandardAutoscaler: the scale-up/scale-down control loop.
+
+Parity: `python/ray/autoscaler/autoscaler.py:376` (StandardAutoscaler,
+driven by `monitor.py`). Policy:
+
+- bringup: launch toward `min_workers` immediately;
+- scale UP when the head reports unplaceable demand (pending task
+  queue + unserved lease requests), in bounded launch batches, never
+  past `max_workers`;
+- scale DOWN workers whose resources have been fully idle for
+  `idle_timeout_s`, never below `min_workers`.
+
+`update()` is pull-driven: `AutoscalerMonitor` (monitor.py) polls the
+head's node table into LoadMetrics and calls it periodically — the same
+shape as the reference's monitor loop, minus the cloud SDKs (see
+node_provider.py).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from .load_metrics import LoadMetrics
+from .node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+DEFAULT_CONFIG = {
+    "min_workers": 0,
+    "max_workers": 4,
+    "idle_timeout_s": 60.0,
+    "max_launch_batch": 2,
+}
+
+
+class StandardAutoscaler:
+    def __init__(self, provider: NodeProvider,
+                 load_metrics: LoadMetrics,
+                 config: Optional[dict] = None):
+        self.provider = provider
+        self.load_metrics = load_metrics
+        self.config = dict(DEFAULT_CONFIG)
+        self.config.update(config or {})
+        self.num_launches = 0
+        self.num_terminations = 0
+
+    # ------------------------------------------------------------------
+    def update(self) -> None:
+        nodes = self.provider.non_terminated_nodes()
+        self.load_metrics.prune_inactive(set(nodes))
+
+        # -- scale down idle nodes (before counting capacity) ----------
+        min_w = int(self.config["min_workers"])
+        idle_timeout = float(self.config["idle_timeout_s"])
+        removable = []
+        for nid in nodes:
+            if nid not in self.load_metrics.static_resources_by_node:
+                continue  # not registered yet: not idle, just young
+            static = self.load_metrics.static_resources_by_node[nid]
+            dynamic = self.load_metrics.dynamic_resources_by_node[nid]
+            fully_idle = all(dynamic.get(k, 0.0) >= v - 1e-9
+                             for k, v in static.items())
+            if fully_idle and \
+                    self.load_metrics.idle_seconds(nid) > idle_timeout:
+                removable.append(nid)
+        for nid in removable:
+            if len(nodes) <= min_w:
+                break
+            logger.info("autoscaler: terminating idle node %s", nid)
+            self.provider.terminate_node(nid)
+            self.num_terminations += 1
+            nodes.remove(nid)
+
+        # -- scale up --------------------------------------------------
+        max_w = int(self.config["max_workers"])
+        target = min_w
+        if self.load_metrics.queued_demand > 0:
+            # Unplaceable work: grow by one launch batch toward max.
+            target = min(max_w, len(nodes)
+                         + int(self.config["max_launch_batch"]))
+        if len(nodes) < target:
+            need = target - len(nodes)
+            logger.info("autoscaler: launching %d node(s) "
+                        "(have %d, queued_demand %d)",
+                        need, len(nodes),
+                        self.load_metrics.queued_demand)
+            for nid in self.provider.create_node(need):
+                self.load_metrics.mark_active(nid)
+            self.num_launches += need

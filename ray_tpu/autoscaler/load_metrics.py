@@ -1,0 +1,53 @@
+"""Cluster load metrics feeding the autoscaler.
+
+Parity: `python/ray/autoscaler/autoscaler.py:155` (LoadMetrics) — the
+reference fills it from raylet heartbeats; here it is filled from the
+head's node table + queue depths (the head already aggregates exactly
+what the raylet heartbeats carried: per-node static/available resource
+vectors and unserved demand).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict
+
+
+class LoadMetrics:
+    def __init__(self):
+        self.static_resources_by_node: Dict[str, dict] = {}
+        self.dynamic_resources_by_node: Dict[str, dict] = {}
+        self.last_used_time_by_node: Dict[str, float] = {}
+        self.last_heartbeat_time_by_node: Dict[str, float] = {}
+        # Demand the scheduler could not place anywhere (pending task
+        # queue + unserved lease requests).
+        self.queued_demand = 0
+
+    def update(self, node_id: str, static: dict, dynamic: dict) -> None:
+        now = time.time()
+        self.static_resources_by_node[node_id] = dict(static)
+        self.dynamic_resources_by_node[node_id] = dict(dynamic)
+        if node_id not in self.last_used_time_by_node \
+                or any(dynamic.get(k, 0.0) < v - 1e-9
+                       for k, v in static.items()):
+            # Any resource in use counts as activity.
+            self.last_used_time_by_node[node_id] = now
+        self.last_heartbeat_time_by_node[node_id] = now
+
+    def mark_active(self, node_id: str) -> None:
+        self.last_used_time_by_node[node_id] = time.time()
+        self.last_heartbeat_time_by_node[node_id] = time.time()
+
+    def prune_inactive(self, active_node_ids) -> None:
+        active = set(active_node_ids)
+        for m in (self.static_resources_by_node,
+                  self.dynamic_resources_by_node,
+                  self.last_used_time_by_node,
+                  self.last_heartbeat_time_by_node):
+            for nid in list(m):
+                if nid not in active:
+                    del m[nid]
+
+    def idle_seconds(self, node_id: str) -> float:
+        last = self.last_used_time_by_node.get(node_id)
+        return 0.0 if last is None else time.time() - last

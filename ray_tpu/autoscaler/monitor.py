@@ -1,0 +1,77 @@
+"""Autoscaler monitor: the loop that drives StandardAutoscaler.
+
+Parity: `python/ray/monitor.py` — the reference's monitor subscribes to
+raylet heartbeats and calls `StandardAutoscaler.update()`. Here the
+head IS the aggregation point, so the monitor polls its cluster-load
+snapshot (in-process when given a HeadServer, over the wire via the
+`cluster_load` RPC otherwise) and feeds LoadMetrics.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+from .autoscaler import StandardAutoscaler
+from .load_metrics import LoadMetrics
+from .node_provider import NodeProvider
+
+logger = logging.getLogger(__name__)
+
+
+class AutoscalerMonitor:
+    def __init__(self, provider: NodeProvider, config: dict,
+                 head=None, head_conn=None,
+                 update_interval_s: float = 1.0):
+        if (head is None) == (head_conn is None):
+            raise ValueError("pass exactly one of head= / head_conn=")
+        self._head = head
+        self._head_conn = head_conn
+        self.load_metrics = LoadMetrics()
+        self.autoscaler = StandardAutoscaler(
+            provider, self.load_metrics, config)
+        self.update_interval_s = update_interval_s
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    def _snapshot(self) -> dict:
+        if self._head is not None:
+            return self._head.cluster_load()
+        return self._head_conn.request(
+            {"kind": "cluster_load"}, timeout=10.0)["load"]
+
+    def poll_once(self) -> None:
+        snap = self._snapshot()
+        # The head node itself is not autoscaler-managed; worker nodes
+        # are matched by the provider's ids.
+        managed = set(self.autoscaler.provider.non_terminated_nodes())
+        for node in snap["nodes"]:
+            if node["node_id"] in managed:
+                self.load_metrics.update(
+                    node["node_id"], node["total_resources"],
+                    node["available_resources"])
+        self.load_metrics.queued_demand = (
+            snap["pending_tasks"] + snap["lease_queue_depth"])
+        self.autoscaler.update()
+
+    def _run(self):
+        while not self._stop.wait(self.update_interval_s):
+            try:
+                self.poll_once()
+            except Exception:
+                logger.exception("autoscaler monitor tick failed")
+
+    def start(self) -> "AutoscalerMonitor":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True, name="autoscaler-monitor")
+        self._thread.start()
+        return self
+
+    def stop(self, terminate_nodes: bool = False):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+        if terminate_nodes:
+            self.autoscaler.provider.shutdown()

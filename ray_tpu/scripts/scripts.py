@@ -90,6 +90,67 @@ def cmd_start(args):
         agent.shutdown()
 
 
+def cmd_up(args):
+    """Boot an autoscaling cluster from a yaml config (parity:
+    `ray up cluster.yaml`, reference scripts.py:622 + autoscaler): a
+    head plus an AutoscalerMonitor launching/retiring LocalNodeProvider
+    worker nodes against load."""
+    import yaml
+
+    from ray_tpu._private import node as node_mod
+    from ray_tpu.autoscaler import LocalNodeProvider
+    from ray_tpu.autoscaler.monitor import AutoscalerMonitor
+
+    with open(args.config_file) as f:
+        cfg = yaml.safe_load(f) or {}
+    resources = node_mod.default_resources()
+    resources.update(cfg.get("head_resources") or {})
+    node = node_mod.Node(resources, num_initial_workers=0,
+                         enable_tcp=True)
+    _record_pid("head")
+    os.makedirs(PID_DIR, exist_ok=True)
+    with open(ADDRESS_FILE, "w") as f:
+        f.write(node.head.tcp_addr)
+    provider = LocalNodeProvider(
+        node.head.tcp_addr, node.session_dir, node.session_name,
+        node_resources=cfg.get("worker_resources") or {"CPU": 1.0},
+        name_prefix=cfg.get("cluster_name", "autoscaled"))
+    monitor = AutoscalerMonitor(
+        provider,
+        {k: cfg[k] for k in ("min_workers", "max_workers",
+                             "idle_timeout_s", "max_launch_batch")
+         if k in cfg},
+        head=node.head,
+        update_interval_s=float(cfg.get("update_interval_s", 1.0)),
+    ).start()
+    print(f"cluster {cfg.get('cluster_name', '?')!r} up at "
+          f"{node.head.tcp_addr} "
+          f"(workers {monitor.autoscaler.config['min_workers']}-"
+          f"{monitor.autoscaler.config['max_workers']})")
+    print(f"attach drivers with: "
+          f"ray_tpu.init(address={node.head.tcp_addr!r})")
+    _block_until_signal()
+    monitor.stop(terminate_nodes=True)
+    node.shutdown()
+
+
+def cmd_down(args):
+    """Tear down a `up`-started cluster (parity: `ray down`). The node
+    agents are children of the `up` process; stopping it reaps them."""
+    cmd_stop(args)
+
+
+def cmd_exec(args):
+    """Run a shell command against the running cluster (parity:
+    `ray exec`): RAY_TPU_ADDRESS is injected so `ray_tpu.init()`
+    inside the command attaches to it."""
+    import subprocess
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = _resolve_address(args)
+    rc = subprocess.call(args.command, shell=True, env=env)
+    sys.exit(rc)
+
+
 def _session_name(address: str) -> str:
     conn = _connect(address)
     try:
@@ -141,6 +202,15 @@ def _resolve_address(args) -> str:
 
 
 def cmd_stat(args):
+    if getattr(args, "config", False):
+        # Config registry dump (parity: ray_config_def.h enumerability).
+        from ray_tpu._private import config as config_mod
+        for row in config_mod.dump():
+            mark = "*" if row["overridden"] else " "
+            print(f"{mark} {row['name']:<40s} "
+                  f"{row['type']:<6s} {row['value']!r:<12} "
+                  f"(default {row['default']!r}) — {row['doc']}")
+        return
     address = _resolve_address(args)
     conn = _connect(address)
     try:
@@ -179,7 +249,8 @@ def cmd_memory(args):
     finally:
         conn.close()
     session = info["session_name"]
-    shm_dir = os.environ.get("RAY_TPU_SHM_DIR", "/dev/shm")
+    from ray_tpu._private import config as config_mod
+    shm_dir = config_mod.get("RAY_TPU_SHM_DIR")
     by_node = {}
     for path in glob.glob(os.path.join(
             shm_dir, f"raytpu_{session}_*")):
@@ -227,6 +298,19 @@ def main(argv=None):
     p = sub.add_parser("stop", help="stop CLI-started processes")
     p.set_defaults(fn=cmd_stop)
 
+    p = sub.add_parser("up", help="boot an autoscaling cluster")
+    p.add_argument("config_file")
+    p.set_defaults(fn=cmd_up)
+
+    p = sub.add_parser("down", help="tear down an up-started cluster")
+    p.set_defaults(fn=cmd_down)
+
+    p = sub.add_parser("exec",
+                       help="run a command against the cluster")
+    p.add_argument("command")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_exec)
+
     for name, fn in (("stat", cmd_stat), ("memory", cmd_memory),
                      ("timeline", cmd_timeline)):
         p = sub.add_parser(name)
@@ -237,6 +321,9 @@ def main(argv=None):
             p.add_argument("--metrics", action="store_true",
                            help="print cluster-aggregated counters/"
                                 "gauges instead of resource state")
+            p.add_argument("--config", action="store_true",
+                           help="dump the tunable-config registry "
+                                "(effective values; * = env override)")
         p.set_defaults(fn=fn)
 
     args = parser.parse_args(argv)

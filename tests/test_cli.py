@@ -59,6 +59,71 @@ class TestTrainCLI:
         assert len(rewards) == 2
         assert all(np.isfinite(r) for r in rewards)
 
+    def test_cluster_up_exec_down(self, tmp_path):
+        """`up` boots a head + autoscaler from yaml; `exec` runs a
+        driver against it via RAY_TPU_ADDRESS; `down` tears it down
+        (parity: reference scripts.py:622 up/exec/down)."""
+        import subprocess
+        import sys
+        import textwrap
+        import time
+
+        cfg = tmp_path / "cluster.yaml"
+        cfg.write_text(textwrap.dedent("""
+            cluster_name: citest
+            min_workers: 0
+            max_workers: 2
+            idle_timeout_s: 5.0
+            head_resources: {CPU: 2}
+            worker_resources: {CPU: 2}
+        """))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = os.pathsep.join(
+            [p for p in sys.path if p]
+            + ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+        from ray_tpu.scripts.scripts import ADDRESS_FILE
+        try:
+            os.unlink(ADDRESS_FILE)  # a stale file would misdirect exec
+        except OSError:
+            pass
+        up = subprocess.Popen(
+            [sys.executable, "-m", "ray_tpu.scripts", "up", str(cfg)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        try:
+            deadline = time.time() + 60
+            addr = None
+            while time.time() < deadline:
+                if up.poll() is not None:
+                    raise AssertionError(
+                        "up exited early:\n" + up.stdout.read())
+                try:
+                    addr = open(ADDRESS_FILE).read().strip()
+                    if addr:
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.2)
+            assert addr, "head address file never appeared"
+            driver = (
+                "import ray_tpu; ray_tpu.init();"
+                "f = ray_tpu.remote(lambda x: x + 1);"
+                "assert ray_tpu.get(f.remote(41)) == 42;"
+                "print('EXEC-OK')")
+            out = subprocess.run(
+                [sys.executable, "-m", "ray_tpu.scripts", "exec",
+                 f"{sys.executable} -c \"{driver}\""],
+                env=env, capture_output=True, text=True, timeout=120)
+            assert "EXEC-OK" in out.stdout, (out.stdout, out.stderr)
+        finally:
+            subprocess.run(
+                [sys.executable, "-m", "ray_tpu.scripts", "down"],
+                env=env, capture_output=True, text=True, timeout=30)
+            try:
+                up.wait(timeout=20)
+            except subprocess.TimeoutExpired:
+                up.kill()
+
     def test_missing_args_error(self):
         from ray_tpu.rllib.train import main
         with pytest.raises(SystemExit):

@@ -115,6 +115,43 @@ class TestMetrics:
         finally:
             ray_tpu.shutdown()
 
+    def test_dashboard_page(self, monkeypatch):
+        """Dashboard-lite at `/` (parity: dashboard.py:91): nodes,
+        actors, store gauges, error + log tails, server-rendered."""
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        monkeypatch.setenv("RAY_TPU_METRICS_PORT", str(port))
+        monkeypatch.setenv("RAY_TPU_METRICS_INTERVAL_S", "0.3")
+        ray_tpu.init(num_cpus=2)
+        try:
+            @ray_tpu.remote
+            class Dash:
+                def ping(self):
+                    return "ok"
+
+            a = Dash.options(name="dash_actor").remote()
+            assert ray_tpu.get(a.ping.remote(), timeout=30) == "ok"
+
+            @ray_tpu.remote
+            def boom():
+                raise RuntimeError("dashboard-test-error")
+
+            with pytest.raises(Exception):
+                ray_tpu.get(boom.remote(), timeout=30)
+            time.sleep(1.2)
+            page = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/", timeout=10).read().decode()
+            assert "<h1>ray_tpu" in page
+            assert "node0" in page
+            assert "dash_actor" in page       # named actor row
+            assert "ALIVE" in page
+            assert "dashboard-test-error" in page  # error tail
+        finally:
+            ray_tpu.shutdown()
+
     def test_stat_metrics_cli(self, monkeypatch):
         monkeypatch.setenv("RAY_TPU_METRICS_INTERVAL_S", "0.3")
         ray_tpu.init(num_cpus=2)
