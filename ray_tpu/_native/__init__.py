@@ -27,13 +27,15 @@ _cache = {}
 
 
 def native_enabled() -> bool:
-    return os.environ.get("RAY_TPU_NATIVE", "1") != "0"
+    from ray_tpu._private import config
+    return bool(config.get("RAY_TPU_NATIVE"))
 
 
 def _cache_dir() -> str:
     # User-owned cache, NOT the world-writable temp dir: a predictable
     # /tmp path could be pre-seeded with a hostile .so by another user.
-    d = os.environ.get("RAY_TPU_NATIVE_CACHE") or os.path.join(
+    from ray_tpu._private import config
+    d = config.get("RAY_TPU_NATIVE_CACHE") or os.path.join(
         os.path.expanduser("~"), ".cache", "ray_tpu_native")
     os.makedirs(d, mode=0o700, exist_ok=True)
     return d

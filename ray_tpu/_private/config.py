@@ -1,0 +1,137 @@
+"""Central config registry: every tunable, typed, in one place.
+
+Parity: `src/ray/common/ray_config_def.h:17-200` — the reference
+declares every knob once (name, type, default) and generates accessors;
+scattered env reads don't exist. Same contract here: modules call
+`config.get("RAY_TPU_X")`, the registry owns the type/default/doc, env
+vars override, and `ray_tpu.scripts stat --config` dumps the effective
+values. Adding a knob = adding one `_def(...)` line; `get()` on an
+unregistered name raises, which is what keeps ad-hoc `os.environ`
+tunables from creeping back in.
+
+Identity/plumbing variables (RAY_TPU_NODE_ID, RAY_TPU_WORKER_TOKEN,
+RAY_TPU_ADDRESS, session paths) are not tunables and stay out.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+
+@dataclass(frozen=True)
+class ConfigDef:
+    name: str
+    type: type
+    default: Any
+    doc: str
+
+
+_DEFS: Dict[str, ConfigDef] = {}
+
+
+def _def(name: str, typ: type, default, doc: str) -> None:
+    _DEFS[name] = ConfigDef(name, typ, default, doc)
+
+
+# --- object store / eviction -----------------------------------------
+_def("RAY_TPU_OBJECT_STORE_CAPACITY", int, None,
+     "Node object-store capacity in bytes (default: 30% of the shm "
+     "filesystem)")
+_def("RAY_TPU_SHM_DIR", str, "/dev/shm",
+     "Directory backing the node-shared object store")
+_def("RAY_TPU_EVICTION_GRACE_S", float, 10.0,
+     "Eviction grace for refs exported OUTSIDE a protocol send "
+     "(unknown destination; the ack_export pin protocol covers the "
+     "rest)")
+_def("RAY_TPU_EXPORT_PIN_TIMEOUT_S", float, 120.0,
+     "Leak backstop for export pins whose ack never arrives")
+_def("RAY_TPU_LINEAGE_MAX_SPECS", int, 10000,
+     "Retained task specs for owner-side result reconstruction (LRU)")
+
+# --- worker leases ----------------------------------------------------
+_def("RAY_TPU_DISABLE_LEASES", bool, False,
+     "Route every task through the head instead of worker leases")
+_def("RAY_TPU_LEASE_PIPELINE_DEPTH", int, 64,
+     "In-flight tasks per leased worker for fast (overhead-bound) "
+     "tasks")
+_def("RAY_TPU_LEASE_FAST_TASK_MS", float, 25.0,
+     "Completion-latency threshold (ms) below which tasks pipeline "
+     "deep")
+_def("RAY_TPU_LEASE_FAST_TASK_MAX_LEASES", int, os.cpu_count() or 1,
+     "Lease-count cap for fast tasks (more workers than cores just "
+     "thrashes)")
+_def("RAY_TPU_LEASE_LINGER_S", float, 2.0,
+     "Idle time before a lease returns its worker to the pool")
+
+# --- liveness / observability ----------------------------------------
+_def("RAY_TPU_HEARTBEAT_INTERVAL_S", float, 0.5,
+     "Node-agent heartbeat period")
+_def("RAY_TPU_HEARTBEAT_TIMEOUT_S", float, 30.0,
+     "Heartbeat silence after which the head declares a node dead")
+_def("RAY_TPU_METRICS_INTERVAL_S", float, 2.0,
+     "Per-process metric push period (0 disables)")
+_def("RAY_TPU_METRICS_PORT", int, 0,
+     "Head HTTP port for /metrics + dashboard (0 disables)")
+_def("RAY_TPU_LOG_TO_DRIVER", bool, True,
+     "Stream worker logs to the driver console")
+_def("RAY_TPU_LOG_LEVEL", str, "WARNING",
+     "Python logging level for daemon processes")
+
+# --- actors -----------------------------------------------------------
+_def("RAY_TPU_NUM_ACTOR_CHECKPOINTS_TO_KEEP", int, 20,
+     "Checkpoint ids retained per Checkpointable actor")
+
+# --- native components ------------------------------------------------
+_def("RAY_TPU_NATIVE", bool, True,
+     "Use compiled C++ components (0 forces pure-Python fallbacks)")
+_def("RAY_TPU_NATIVE_CACHE", str, None,
+     "Directory for compiled native components "
+     "(default ~/.cache/ray_tpu_native)")
+
+# --- streaming --------------------------------------------------------
+_def("RAY_TPU_STREAMING_CREDITS", int, 32,
+     "Max unprocessed items in flight per streaming operator edge")
+
+
+def get(name: str):
+    """Effective value: env override parsed to the declared type, else
+    the registered default. Unregistered names raise (tunables must be
+    declared here)."""
+    d = _DEFS.get(name)
+    if d is None:
+        raise KeyError(
+            f"{name} is not a registered tunable; declare it in "
+            f"_private/config.py")
+    raw = os.environ.get(name)
+    if raw is None or raw == "":
+        return d.default
+    if d.type is bool:
+        return raw.strip().lower() not in ("0", "false", "no", "off")
+    try:
+        return d.type(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{name}={raw!r} is not a valid {d.type.__name__}")
+
+
+def defs() -> Dict[str, ConfigDef]:
+    return dict(_DEFS)
+
+
+def dump() -> list:
+    """Effective config for `stat --config`: one row per tunable."""
+    out = []
+    for name in sorted(_DEFS):
+        d = _DEFS[name]
+        overridden = os.environ.get(name) not in (None, "")
+        out.append({
+            "name": name,
+            "type": d.type.__name__,
+            "default": d.default,
+            "value": get(name),
+            "overridden": overridden,
+            "doc": d.doc,
+        })
+    return out

@@ -17,8 +17,9 @@ def main():
     parser.add_argument("--session-name", required=True)
     args = parser.parse_args()
 
+    from ray_tpu._private import config as _config
     logging.basicConfig(
-        level=os.environ.get("RAY_TPU_LOG_LEVEL", "WARNING"),
+        level=_config.get("RAY_TPU_LOG_LEVEL"),
         format=f"[worker {os.getpid()}] %(levelname)s %(name)s: %(message)s")
 
     # Make the repo importable the same way the driver sees it.

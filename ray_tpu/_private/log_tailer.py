@@ -69,19 +69,20 @@ class LogTailer(threading.Thread):
                 # `continue` would wedge this file's tailing forever).
                 # Back off to a UTF-8 character boundary so a multi-byte
                 # char split at MAX_CHUNK isn't mangled across shipments.
-                # A valid split strips at most 3 continuation bytes +
-                # 1 lead byte; more means non-UTF-8 (binary) content —
-                # ship it raw rather than re-wedging the offset.
+                # A valid split needs at most 3 trailing bytes removed;
+                # verify by decoding. Binary (non-UTF-8) content ships
+                # raw rather than re-wedging the offset.
                 if len(chunk) < MAX_CHUNK:
                     continue
-                trimmed = chunk
-                for _ in range(3):
-                    if trimmed and (trimmed[-1] & 0xC0) == 0x80:
-                        trimmed = trimmed[:-1]
-                if trimmed and trimmed[-1] >= 0xC0:  # orphaned lead byte
-                    trimmed = trimmed[:-1]
-                if trimmed and (trimmed[-1] & 0xC0) != 0x80:
-                    chunk = trimmed
+                for back in range(4):
+                    candidate = chunk[:len(chunk) - back]
+                    try:
+                        candidate.decode("utf-8")
+                    except UnicodeDecodeError:
+                        continue
+                    if candidate:
+                        chunk = candidate
+                    break
             else:
                 chunk = chunk[:cut + 1]
             self._offsets[path] = offset + len(chunk)

@@ -64,7 +64,8 @@ class NodeAgent:
         # Tail this node's worker logs to the driver console via head
         # pub/sub (parity: log_monitor.py on every node).
         self._log_tailer = None
-        if os.environ.get("RAY_TPU_LOG_TO_DRIVER", "1") != "0":
+        from . import config
+        if config.get("RAY_TPU_LOG_TO_DRIVER"):
             from .log_tailer import LogTailer
             self._log_tailer = LogTailer(
                 os.path.join(session_dir, "logs"), node_id,
@@ -124,8 +125,8 @@ class NodeAgent:
         # node: a SIGSTOPped agent keeps its TCP socket open but stops
         # beating (reference: raylet_heartbeat_timeout_milliseconds,
         # `ray_config_def.h:24`).
-        hb_interval = float(os.environ.get(
-            "RAY_TPU_HEARTBEAT_INTERVAL_S", "0.5"))
+        from . import config
+        hb_interval = config.get("RAY_TPU_HEARTBEAT_INTERVAL_S")
         last_hb = 0.0
         while not self._shutdown.is_set():
             time.sleep(0.05)
@@ -190,7 +191,8 @@ def main():
     parser.add_argument("--session-dir", required=True)
     parser.add_argument("--session-name", required=True)
     args = parser.parse_args()
-    logging.basicConfig(level=os.environ.get("RAY_TPU_LOG_LEVEL", "WARNING"))
+    from . import config
+    logging.basicConfig(level=config.get("RAY_TPU_LOG_LEVEL"))
     agent = NodeAgent(args.head_addr, args.node_id,
                       json.loads(args.resources), args.session_dir,
                       args.session_name)

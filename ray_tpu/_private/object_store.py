@@ -25,7 +25,9 @@ from typing import Dict, Optional
 from . import serialization
 from .ids import ObjectID
 
-SHM_DIR = os.environ.get("RAY_TPU_SHM_DIR", "/dev/shm")
+from . import config as _config
+
+SHM_DIR = _config.get("RAY_TPU_SHM_DIR")
 # Objects smaller than this are pushed inline over sockets rather than via
 # shm (reference: `max_direct_call_object_size` = 100 KiB,
 # `src/ray/common/ray_config_def.h:54`).

@@ -35,6 +35,7 @@ import cloudpickle
 
 from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
                           TaskError, WorkerCrashedError)
+from . import config
 from . import object_ref as object_ref_mod
 from . import protocol, serialization
 from .ids import ActorID, JobID, ObjectID, TaskID
@@ -134,14 +135,18 @@ class _RefTracker:
                     _, attempt = retry_at.get(owner, (0, 0))
                     if attempt >= 5:
                         # Unreachable through the whole backoff window:
-                        # likely dead — drop this message (fresh budget
-                        # for the next one) rather than stall forever.
+                        # likely dead. Drop this owner's ENTIRE queue —
+                        # delivering a later message after dropping an
+                        # earlier one would break pairing invariants
+                        # (e.g. an ack_export landing after its
+                        # add_borrow was dropped releases the owner's
+                        # pin with no borrow registered).
                         logger.warning(
-                            "dropping %s notification for %s to %s: %r",
-                            kind, oid, owner, e)
-                        q.popleft()
-                        retry_at[owner] = (0.0, 0)
-                        continue
+                            "owner %s unreachable; dropping %d queued "
+                            "notification(s) (first: %s for %s): %r",
+                            owner, len(q), kind, oid, e)
+                        q.clear()
+                        break
                     retry_at[owner] = (
                         time.monotonic() + 0.05 * (2 ** attempt),
                         attempt + 1)
@@ -169,6 +174,57 @@ class _RefTracker:
             for owner in [o for o, (due, _) in retry_at.items()
                           if due <= now]:
                 drain(owner)
+
+
+class _Batcher:
+    """Conflating sender for the per-message data plane.
+
+    The hot path's floor is one pickle + one sendall syscall per
+    message. Under load, messages arrive faster than a send completes;
+    this drains EVERYTHING queued each wakeup and ships one
+    `msg_batch` per destination — batching emerges exactly when
+    there's contention and adds zero latency when idle (the classic
+    conflation pattern; reference analog: gRPC's stream write
+    coalescing). Per-destination FIFO order is preserved (single
+    drain thread). Send failures surface through the connection's
+    on_close path, same as the async failure handling callers of
+    fire-and-forget sends already rely on.
+    """
+
+    def __init__(self, get_conn):
+        import queue as _queue
+        self._get_conn = get_conn
+        self._q: "_queue.SimpleQueue" = _queue.SimpleQueue()
+        self._thread = threading.Thread(
+            target=self._loop, daemon=True, name="send-batcher")
+        self._thread.start()
+
+    def send(self, addr: str, msg: dict) -> None:
+        self._q.put((addr, msg))
+
+    def _loop(self):
+        while True:
+            addr, msg = self._q.get()
+            by_addr: Dict[str, list] = {addr: [msg]}
+            # Drain the burst that accumulated behind us.
+            while True:
+                try:
+                    addr, msg = self._q.get_nowait()
+                except Exception:
+                    break
+                by_addr.setdefault(addr, []).append(msg)
+            for addr, msgs in by_addr.items():
+                try:
+                    conn = self._get_conn(addr)
+                    if len(msgs) == 1:
+                        conn.send(msgs[0])
+                    else:
+                        conn.send({"kind": "msg_batch", "msgs": msgs})
+                except Exception:
+                    logger.warning(
+                        "batched send of %d message(s) to %s failed "
+                        "(peer-close handling takes over)",
+                        len(msgs), addr)
 
 
 class _Cell:
@@ -269,15 +325,22 @@ class Runtime:
         # borrows) objects evict in LRU order.
         from collections import OrderedDict
         self._owned: "OrderedDict[ObjectID, int]" = OrderedDict()
+        # Running byte total of _owned: summing the dict on every
+        # _make_room made put() O(n) in live objects.
+        self._owned_bytes = 0
         self._owned_lock = threading.Lock()
-        self._borrows: Dict[ObjectID, int] = {}
-        cap = os.environ.get("RAY_TPU_OBJECT_STORE_CAPACITY")
+        # Registered borrows, PER PEER (oid -> {peer_addr: count}):
+        # per-peer floors make a stray remove_borrow (e.g. after its
+        # add_borrow was dropped toward an unreachable owner) unable to
+        # release another peer's borrow, and peer death releases
+        # exactly that peer's borrows.
+        self._borrows: Dict[ObjectID, Dict[str, int]] = {}
+        cap = config.get("RAY_TPU_OBJECT_STORE_CAPACITY")
         if cap is not None:
             self._store_capacity = int(cap)
         else:
             try:
-                st = os.statvfs(
-                    os.environ.get("RAY_TPU_SHM_DIR", "/dev/shm"))
+                st = os.statvfs(config.get("RAY_TPU_SHM_DIR"))
                 # f_blocks (total, not free) so every process on the node
                 # derives the SAME capacity — the store is node-shared.
                 self._store_capacity = int(
@@ -297,8 +360,7 @@ class Runtime:
         # path, used only for exports outside a protocol send (e.g. a
         # user pickling a ref to disk) where the destination is unknown.
         self._exported_at: Dict[ObjectID, float] = {}
-        self._eviction_grace = float(
-            os.environ.get("RAY_TPU_EVICTION_GRACE_S", "10"))
+        self._eviction_grace = config.get("RAY_TPU_EVICTION_GRACE_S")
         # Acknowledged-export pins (parity: reference_count.h borrower
         # tracking; replaces the r3 wall-clock grace, VERDICT r3 #4):
         # every owned ref exported through a protocol send pins
@@ -310,8 +372,8 @@ class Runtime:
         # are never deserialized, and head-relayed specs whose pin peer
         # is the relay while the ack comes from the final recipient).
         self._export_pins: Dict[ObjectID, list] = {}
-        self._export_pin_timeout = float(
-            os.environ.get("RAY_TPU_EXPORT_PIN_TIMEOUT_S", "120"))
+        self._export_pin_timeout = config.get(
+            "RAY_TPU_EXPORT_PIN_TIMEOUT_S")
         protocol.set_serialize_hooks(
             object_ref_mod.begin_export_collection,
             self._finish_export_collection)
@@ -355,27 +417,25 @@ class Runtime:
         self._lease_by_addr: Dict[str, tuple] = {}  # worker -> group key
         self._leased_pending: Dict[str, Dict[TaskID, TaskSpec]] = {}
         self._leased_tid_addr: Dict[TaskID, str] = {}
-        self._use_leases = os.environ.get(
-            "RAY_TPU_DISABLE_LEASES", "0") != "1"
+        self._use_leases = not config.get("RAY_TPU_DISABLE_LEASES")
         # Per-lease pipeline depth is ADAPTIVE on observed task latency:
         # fast tasks (completion under the fast-task threshold) pipeline
         # deep — per-task dispatch overhead dominates, parallelism is
         # worthless; slow tasks keep pipelines shallow so excess demand
         # stays caller-side where leases granted on OTHER nodes (head
         # spillback) can drain it. Lease demand scales as demand/depth.
-        self._lease_depth_deep = int(
-            os.environ.get("RAY_TPU_LEASE_PIPELINE_DEPTH", "64"))
+        self._lease_depth_deep = config.get(
+            "RAY_TPU_LEASE_PIPELINE_DEPTH")
         self._lease_depth_shallow = 2
-        self._lease_fast_task_s = float(
-            os.environ.get("RAY_TPU_LEASE_FAST_TASK_MS", "25")) / 1000.0
+        self._lease_fast_task_s = config.get(
+            "RAY_TPU_LEASE_FAST_TASK_MS") / 1000.0
         # Fast (overhead-bound) tasks gain nothing from more worker
         # processes than physical cores — beyond that, context-switch
         # thrash LOWERS throughput. Slow tasks are uncapped: their
         # parallelism (incl. cross-node spill) is the whole point.
-        self._lease_fast_cap = max(1, int(os.environ.get(
-            "RAY_TPU_LEASE_FAST_TASK_MAX_LEASES", os.cpu_count() or 1)))
-        self._lease_linger_s = float(
-            os.environ.get("RAY_TPU_LEASE_LINGER_S", "2.0"))
+        self._lease_fast_cap = max(1, config.get(
+            "RAY_TPU_LEASE_FAST_TASK_MAX_LEASES"))
+        self._lease_linger_s = config.get("RAY_TPU_LEASE_LINGER_S")
         self._lease_sweeper_started = False
 
         # Lineage-lite (reference: owner-side retries,
@@ -394,8 +454,7 @@ class Runtime:
         self._inflight_tasks: Dict[TaskID, int] = {}
         self._freed_returns: Dict[TaskID, Set[ObjectID]] = {}
         self._lineage_lock = threading.Lock()
-        self._lineage_max = int(
-            os.environ.get("RAY_TPU_LINEAGE_MAX_SPECS", "10000"))
+        self._lineage_max = config.get("RAY_TPU_LINEAGE_MAX_SPECS")
 
         # Worker-side execution state.
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
@@ -422,13 +481,16 @@ class Runtime:
                              "RAY_TPU_WORKER_TOKEN", "")},
             on_close=self._on_head_close)
 
+        # Conflating sender for the hot data plane (see _Batcher).
+        self._batcher = _Batcher(self._get_conn)
+
         from .profiling import Profiler
         self.profiler = Profiler(self, role)
         # Periodic metric pushes to the head (parity: reporter.py psutil
         # stats + OpenCensus flushes; `ray_tpu stat --metrics` reads the
         # head-side aggregate).
-        self._metrics_interval = float(
-            os.environ.get("RAY_TPU_METRICS_INTERVAL_S", "2.0"))
+        self._metrics_interval = config.get(
+            "RAY_TPU_METRICS_INTERVAL_S")
         if self._metrics_interval > 0:
             threading.Thread(target=self._metrics_push_loop, daemon=True,
                              name="metrics-push").start()
@@ -444,10 +506,28 @@ class Runtime:
             raise TypeError("put() of an ObjectRef is not allowed")
         oid = ObjectID.generate()
         meta, buffers, total = serialization.serialize(value)
-        self._make_room(total)
-        self.shm.create_and_seal(oid, meta, buffers, total)
+        if total <= INLINE_OBJECT_MAX:
+            # Small objects stay in the owner's memory store as their
+            # serialized snapshot — no shm file round-trip (file
+            # create + seal dominates sub-100KiB put latency), and
+            # storing bytes (not the live object) keeps put()'s
+            # copy semantics. Borrowers fetch inline from the owner
+            # (`_on_get_object` "raw" path), same as small task
+            # results (parity: CoreWorkerMemoryStore for direct-call
+            # objects, `max_direct_call_object_size`). Still `_owned`-
+            # accounted so eviction and free() govern it.
+            # One serialization pass: assemble the standalone blob from
+            # the already-computed meta/buffers.
+            out = bytearray(total)
+            serialization.write_blob(memoryview(out), meta, buffers)
+            self._make_room(total)
+            self.memory.put(oid, _Cell("raw", bytes(out)))
+        else:
+            self._make_room(total)
+            self.shm.create_and_seal(oid, meta, buffers, total)
         with self._owned_lock:
             self._owned[oid] = total
+            self._owned_bytes += total
         return ObjectRef(oid, self.addr, total)
 
     # -- acknowledged-borrow export pins --------------------------------
@@ -483,8 +563,9 @@ class Runtime:
             self._export_pins.pop(oid, None)
 
     def _drop_peer_pins(self, peer_addr: str):
-        """A peer's connection died: its in-flight copies are gone and
-        no acknowledgement will ever come."""
+        """A peer's connection died: its in-flight copies are gone, no
+        acknowledgement will ever come, and its registered borrows are
+        released (parity: borrower death in reference_count.h)."""
         with self._owned_lock:
             for oid in list(self._export_pins):
                 pins = [(p, d) for p, d in self._export_pins[oid]
@@ -493,6 +574,16 @@ class Runtime:
                     self._export_pins[oid] = pins
                 else:
                     self._export_pins.pop(oid)
+            # Tradeoff: a TRANSIENT connection drop (network blip on a
+            # TCP peer) also lands here, releasing a live borrower's
+            # borrows early — lineage reconstruction covers the rare
+            # eviction that follows; retaining them forever on real
+            # death would leak unboundedly.
+            for oid in list(self._borrows):
+                per = self._borrows[oid]
+                per.pop(peer_addr, None)
+                if not per:
+                    self._borrows.pop(oid)
 
     def _has_live_pin_locked(self, oid: ObjectID, now: float) -> bool:
         """Caller holds _owned_lock. Prunes expired pins as it checks."""
@@ -515,7 +606,7 @@ class Runtime:
         objects it owns."""
         from ..exceptions import ObjectStoreFullError
         with self._owned_lock:
-            own = sum(self._owned.values())
+            own = self._owned_bytes
             self._bytes_since_refresh += incoming
             # Fast path: even if every other process held the rest of
             # the capacity when we last looked, we still fit. The cache
@@ -540,7 +631,7 @@ class Runtime:
                     break
                 if self.ref_tracker.count(oid) > 0:
                     continue
-                if self._borrows.get(oid, 0) > 0:
+                if self._borrows.get(oid):
                     continue
                 # Exported refs with an unacknowledged borrow in flight
                 # are pinned until the recipient's add_borrow lands (or
@@ -555,7 +646,9 @@ class Runtime:
                     continue
                 victims.append(oid)
                 self._exported_at.pop(oid, None)
-                used -= self._owned.pop(oid)
+                size = self._owned.pop(oid)
+                self._owned_bytes -= size
+                used -= size
             over = used + incoming > self._store_capacity
         for oid in victims:
             self.memory.delete(oid)
@@ -785,7 +878,7 @@ class Runtime:
             self.memory.delete(r.id)
             self.shm.delete(r.id)
             with self._owned_lock:
-                self._owned.pop(r.id, None)
+                self._owned_bytes -= self._owned.pop(r.id, 0)
                 self._exported_at.pop(r.id, None)
                 self._export_pins.pop(r.id, None)
             # Explicit free forfeits reconstruction — but only once EVERY
@@ -838,6 +931,7 @@ class Runtime:
                 self.shm.create_and_seal(oid, meta, buffers, total)
                 with self._owned_lock:
                     self._owned[oid] = total
+                    self._owned_bytes += total
                 return ArgSpec(ref=ObjectRef(oid, self.addr, total))
             out = bytearray(total)
             serialization.write_blob(memoryview(out), meta, buffers)
@@ -947,12 +1041,11 @@ class Runtime:
 
     def _push_leased(self, addr: str, spec: TaskSpec):
         spec.leased = True
-        try:
-            self._get_conn(addr).send({"kind": "execute_task",
-                                       "spec": spec})
-        except (protocol.ConnectionClosed, FileNotFoundError,
-                ConnectionRefusedError):
-            self._on_lease_worker_lost(addr)
+        # Conflated send: bursts of submissions coalesce into one
+        # message per worker (send failures surface via the worker
+        # connection's on_close -> _on_lease_worker_lost, and the
+        # head's liveness plane backstops an unreachable dial).
+        self._batcher.send(addr, {"kind": "execute_task", "spec": spec})
 
     def _on_lease_granted(self, msg: dict):
         key = tuple(sorted(msg["resources"].items()))
@@ -1305,10 +1398,13 @@ class Runtime:
             self._on_push_task(msg["spec"])
         elif kind == "object_chunk":
             self._on_object_chunk(msg)
+        elif kind == "msg_batch":
+            for m in msg["msgs"]:
+                self._handle(conn, m)
         elif kind == "add_borrow":
             with self._owned_lock:
-                self._borrows[msg["object_id"]] = \
-                    self._borrows.get(msg["object_id"], 0) + 1
+                per = self._borrows.setdefault(msg["object_id"], {})
+                per[conn.peer_addr] = per.get(conn.peer_addr, 0) + 1
         elif kind == "ack_export":
             # One delivered copy acknowledged: release its eviction pin
             # (the sender's add_borrow, when any, was ordered before
@@ -1317,11 +1413,15 @@ class Runtime:
                 self._consume_export_pin(msg["object_id"], conn.peer_addr)
         elif kind == "remove_borrow":
             with self._owned_lock:
-                n = self._borrows.get(msg["object_id"], 1) - 1
-                if n <= 0:
-                    self._borrows.pop(msg["object_id"], None)
-                else:
-                    self._borrows[msg["object_id"]] = n
+                per = self._borrows.get(msg["object_id"])
+                if per is not None:
+                    n = per.get(conn.peer_addr, 0) - 1
+                    if n <= 0:
+                        per.pop(conn.peer_addr, None)
+                    else:
+                        per[conn.peer_addr] = n
+                    if not per:
+                        self._borrows.pop(msg["object_id"], None)
         elif kind == "lease_granted":
             self._on_lease_granted(msg)
         elif kind == "leased_worker_died":
@@ -1531,6 +1631,14 @@ class Runtime:
         same_node = node in ("", self.node_id)
         msg = {"kind": "push_result", "object_id": oid}
         if error is not None:
+            # Error-table entry for the dashboard/driver streams
+            # (parity: push_error_to_driver -> GCS error table shown on
+            # the reference dashboard). Best-effort.
+            try:
+                self.head.send({"kind": "report_error",
+                                "data": str(error)[:300]})
+            except Exception:
+                pass
             import pickle as _stdpickle
             try:
                 # The transport frames with stdlib pickle, so probe with it:
@@ -1552,18 +1660,28 @@ class Runtime:
                 self.shm.create_and_seal(oid, meta, buffers, total)
                 msg["in_shm"] = True
             elif total > INLINE_OBJECT_MAX:
-                # Cross-node result: stream the blob to the owner's node,
-                # landing it in THEIR shared store; the ordered push_result
-                # behind the chunks then finds it sealed there.
-                out = bytearray(total)
-                serialization.write_blob(memoryview(out), meta, buffers)
-                self._send_blob_to(addr, oid, bytes(out))
+                # Cross-node result: stream the blob to the owner's node
+                # in chunks WITHOUT materializing it (a multi-GB result
+                # must not double this worker's memory); the ordered
+                # push_result behind the chunks finds it sealed there.
+                num = max(1, (total + OBJECT_CHUNK_SIZE - 1)
+                          // OBJECT_CHUNK_SIZE)
+                try:
+                    self._stream_chunks(
+                        self._get_conn(addr), oid,
+                        serialization.iter_blob_chunks(
+                            meta, buffers, total, OBJECT_CHUNK_SIZE),
+                        num)
+                except (protocol.ConnectionClosed, FileNotFoundError,
+                        ConnectionRefusedError):
+                    logger.warning("could not stream result %s to %s",
+                                   oid, addr)
                 msg["in_shm"] = True
             else:
                 out = bytearray(total)
                 serialization.write_blob(memoryview(out), meta, buffers)
                 msg["data"] = bytes(out)
-        self._send_result(addr, msg)
+        self._send_result(addr, msg, batch="in_shm" not in msg)
 
     @staticmethod
     def _stream_chunks(conn, oid: ObjectID, parts, num: int):
@@ -1599,9 +1717,14 @@ class Runtime:
                 ConnectionRefusedError):
             logger.warning("could not stream object %s to %s", oid, addr)
 
-    def _send_result(self, addr: str, msg: dict):
+    def _send_result(self, addr: str, msg: dict, batch: bool = False):
         if addr == self.addr:
             self._on_push_result(msg)
+            return
+        if batch:
+            # Inline results (no preceding chunk stream to stay ordered
+            # behind) ride the conflating batcher.
+            self._batcher.send(addr, msg)
             return
         try:
             self._get_conn(addr).send(msg)

@@ -69,6 +69,63 @@ def write_blob(dst: memoryview, meta: bytes, buffers: List[pickle.PickleBuffer])
     return pos
 
 
+def iter_blob_chunks(meta: bytes, buffers: List[pickle.PickleBuffer],
+                     total: int, chunk_size: int):
+    """Yield the standalone blob in `chunk_size` pieces WITHOUT ever
+    materializing it (cross-node results can be multi-GB; building
+    `bytearray(total)` would double the worker's memory). Walks the
+    same layout write_blob produces, buffering at most one chunk."""
+    out = bytearray()
+    pos = 0  # logical position in the blob
+
+    def emit(data):
+        nonlocal out
+        out += data
+        while len(out) >= chunk_size:
+            yield bytes(out[:chunk_size])
+            del out[:chunk_size]
+
+    def gen():
+        nonlocal pos
+        hdr = bytearray(_HDR.size)
+        _HDR.pack_into(hdr, 0, _VERSION, len(meta))
+        yield from emit(hdr)
+        pos += _HDR.size
+        yield from emit(meta)
+        pos += len(meta)
+        bufhdr = bytearray(_BUFHDR.size)
+        _BUFHDR.pack_into(bufhdr, 0, len(buffers))
+        yield from emit(bufhdr)
+        pos += _BUFHDR.size
+        # Entry table: offsets follow the same alignment walk as
+        # write_blob.
+        entries = bytearray(_BUFENT.size * len(buffers))
+        walk = pos + len(entries)
+        offs = []
+        for i, buf in enumerate(buffers):
+            nb = buf.raw().nbytes
+            walk = _align(walk)
+            _BUFENT.pack_into(entries, i * _BUFENT.size, walk, nb)
+            offs.append(walk)
+            walk += nb
+        yield from emit(entries)
+        pos += len(entries)
+        for buf, off in zip(buffers, offs):
+            if off > pos:  # alignment padding
+                yield from emit(b"\x00" * (off - pos))
+                pos = off
+            mv = buf.raw().cast("B")
+            for i in range(0, mv.nbytes, chunk_size):
+                yield from emit(mv[i:i + chunk_size])
+            pos += mv.nbytes
+        if pos < total:  # trailing padding (none today, but exact)
+            yield from emit(b"\x00" * (total - pos))
+        if out:
+            yield bytes(out)
+
+    return gen()
+
+
 def dumps(value) -> bytes:
     """Serialize to a standalone bytes blob (for inline transport)."""
     meta, buffers, total = serialize(value)

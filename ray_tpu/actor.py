@@ -195,6 +195,12 @@ class Checkpointable:
     `load_checkpoint(actor_id, available_checkpoints)` runs AFTER
     `__init__` so the instance can restore state instead of starting
     from the bare creation replay.
+
+    Concurrency note: with max_concurrency == 1 (the default), no task
+    runs while save_checkpoint executes. Actors running concurrent
+    tasks (max_concurrency > 1) already own their state's
+    synchronization, and that responsibility extends to
+    save_checkpoint reading it.
     """
 
     def should_checkpoint(self, checkpoint_context: CheckpointContext):

@@ -8,13 +8,30 @@ compiles to a chain of operator actors connected by ordered actor calls
 sequence numbers give the same ordered-delivery guarantee the
 reference's ring-buffer channels provide). key_by hash-partitions items
 across the downstream operator's parallel instances.
+
+Flow control (parity: the bounded ring buffers of
+`streaming/src/ring_buffer.cc` + `data_writer.cc` backpressure): every
+edge carries at most `credits` unprocessed items. Each sender retains
+the result refs of its pushes per downstream instance; at the credit
+limit it blocks on the OLDEST ref (ordered actor streams complete
+in order) before pushing more, so a fast source stalls against a slow
+sink instead of growing an unbounded queue — back-pressure propagates
+hop by hop up to the driver's source loop.
 """
 
 from __future__ import annotations
 
+from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
+from ray_tpu._private import config as _config
+
+
+def _default_credits() -> int:
+    # Read at use time, not import time, so env overrides applied after
+    # import (and `stat --config`'s report) stay truthful.
+    return _config.get("RAY_TPU_STREAMING_CREDITS")
 
 
 def _stable_hash(key) -> int:
@@ -27,12 +44,17 @@ class _OperatorActor:
     """One parallel instance of one operator stage."""
 
     def __init__(self, kind: str, fn_bytes, downstream_handles,
-                 instance_id: int):
+                 instance_id: int, credits: int = None):
         import cloudpickle
         self.kind = kind
         self.fn = cloudpickle.loads(fn_bytes) if fn_bytes else None
         self.downstream = downstream_handles
         self.instance_id = instance_id
+        self.credits = max(1, credits if credits is not None
+                           else _default_credits())
+        # Per-downstream-edge in-flight push refs (the credit window).
+        self._inflight: List[deque] = [deque()
+                                       for _ in downstream_handles]
         self._state: Dict[Any, Any] = {}  # key -> accumulated value
         self._sink: List[Any] = []
         self._rr = 0
@@ -69,8 +91,8 @@ class _OperatorActor:
         else:
             i = self._rr
             self._rr = (self._rr + 1) % len(self.downstream)
-        # Fire-and-forget ordered actor call (the channel push).
-        self.downstream[i].process.remote(item, key)
+        push_with_credits(self.downstream[i], self._inflight[i],
+                          self.credits, item, key)
 
     # -- control ---------------------------------------------------------
     def flush(self):
@@ -89,6 +111,16 @@ class _OperatorActor:
 
     def reduce_state(self):
         return dict(self._state)
+
+
+def push_with_credits(handle, inflight: deque, credits: int,
+                      item, key=None):
+    """Ordered push bounded by the edge's credit window: at the limit,
+    block on the oldest outstanding push (completes first — actor
+    streams are ordered) before issuing the next."""
+    while len(inflight) >= credits:
+        ray_tpu.get(inflight.popleft())
+    inflight.append(handle.process.remote(item, key))
 
 
 class DataStream:
@@ -129,15 +161,23 @@ class DataStream:
 class ExecutionGraph:
     """A materialized pipeline (parity: `streaming.py:46`)."""
 
-    def __init__(self, stage_actors: List[List], source_items):
+    def __init__(self, stage_actors: List[List], source_items,
+                 credits: int = None):
         self.stage_actors = stage_actors
         self._source_items = source_items
+        self._credits = max(1, credits if credits is not None
+                            else _default_credits())
 
     def run(self):
-        """Push every source item through, then flush the DAG."""
+        """Push every source item through, then flush the DAG. The
+        source loop itself respects the credit window: a slow sink
+        stalls THIS loop, not an unbounded in-cluster queue."""
         first = self.stage_actors[0]
+        inflight = [deque() for _ in first]
         for i, item in enumerate(self._source_items):
-            first[i % len(first)].process.remote(item)
+            j = i % len(first)
+            push_with_credits(first[j], inflight[j], self._credits,
+                              item)
         ray_tpu.get([a.flush.remote() for a in first])
         return self
 
@@ -156,8 +196,10 @@ class ExecutionGraph:
 
 
 class StreamingContext:
-    def __init__(self):
+    def __init__(self, credits: int = None):
         self._cls = ray_tpu.remote(_OperatorActor)
+        self._credits = max(1, credits if credits is not None
+                            else _default_credits())
 
     def from_collection(self, items) -> DataStream:
         self._items = list(items)
@@ -172,10 +214,11 @@ class StreamingContext:
             fn_bytes = cloudpickle.dumps(spec["fn"]) if spec["fn"] \
                 else None
             actors = [
-                self._cls.remote(spec["kind"], fn_bytes, downstream, i)
+                self._cls.remote(spec["kind"], fn_bytes, downstream, i,
+                                 self._credits)
                 for i in range(max(1, spec["parallelism"]))]
             stage_actors.insert(0, actors)
             downstream = actors
         if not stage_actors:
             raise ValueError("empty pipeline")
-        return ExecutionGraph(stage_actors, self._items)
+        return ExecutionGraph(stage_actors, self._items, self._credits)

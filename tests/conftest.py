@@ -27,6 +27,13 @@ except Exception:
 import pytest
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "soak: long-running chaos workload (opt-in via RAY_TPU_SOAK=1; "
+        "parity: ci/long_running_tests)")
+
+
 @pytest.fixture
 def ray_start():
     """Boot a real multi-process runtime for a test, like the reference's

@@ -112,7 +112,7 @@ class TestExportPins:
             "exported object evicted before its borrow was acknowledged"
         # The (delayed) acknowledgement arrives; borrow registered.
         with rt._owned_lock:
-            rt._borrows[oid] = rt._borrows.get(oid, 0) + 1
+            rt._borrows.setdefault(oid, {})["fake-peer-addr"] = 1
             rt._consume_export_pin(oid, "fake-peer-addr")
         assert oid not in rt._export_pins
         # Borrow released -> object becomes evictable again.

@@ -1,0 +1,99 @@
+"""Soak: long-running chaos workload (opt-in).
+
+Parity: `ci/long_running_tests/workloads/` — the reference soaks the
+runtime with actor_deaths.py, node_failures.py, and many_tasks.py for
+hours. This compresses the same three stressors into one configurable
+run: a multi-node cluster under continuous task load while actors are
+killed and restarted and whole nodes are SIGKILLed and replaced.
+
+Opt-in: `RAY_TPU_SOAK=1 pytest -m soak tests/test_soak.py`.
+Duration defaults to 60 s for a smoke pass; the VERDICT-spec 10-minute
+run is `RAY_TPU_SOAK=1 RAY_TPU_SOAK_SECONDS=600 pytest -m soak ...`.
+"""
+
+import os
+import random
+import time
+
+import pytest
+
+pytestmark = [
+    pytest.mark.soak,
+    pytest.mark.skipif(
+        os.environ.get("RAY_TPU_SOAK") != "1",
+        reason="soak workload is opt-in (set RAY_TPU_SOAK=1)"),
+]
+
+
+def test_soak_tasks_actor_deaths_node_failures():
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    duration = float(os.environ.get("RAY_TPU_SOAK_SECONDS", "60"))
+    rng = random.Random(0)
+    cluster = Cluster(head_resources={"CPU": 2})
+    nodes = [cluster.add_node(resources={"CPU": 2}) for _ in range(2)]
+
+    @ray_tpu.remote(max_retries=4)
+    def work(x):
+        return x * x
+
+    @ray_tpu.remote(max_restarts=-1)
+    class Counter:
+        def __init__(self):
+            self.n = 0
+
+        def bump(self):
+            self.n += 1
+            return self.n
+
+        def die(self):
+            os._exit(1)
+
+    actors = [Counter.remote() for _ in range(4)]
+    stats = {"tasks": 0, "bumps": 0, "actor_kills": 0,
+             "node_kills": 0, "retried_errors": 0}
+    deadline = time.time() + duration
+    last_chaos = time.time()
+    while time.time() < deadline:
+        # Many tasks: a burst each cycle, results must be exact.
+        xs = [rng.randrange(1000) for _ in range(40)]
+        got = ray_tpu.get([work.remote(x) for x in xs], timeout=120)
+        assert got == [x * x for x in xs]
+        stats["tasks"] += len(xs)
+        # Actor traffic (survives restarts; counters may reset — only
+        # liveness is asserted).
+        for a in actors:
+            try:
+                ray_tpu.get(a.bump.remote(), timeout=60)
+                stats["bumps"] += 1
+            except ray_tpu.ActorDiedError:
+                stats["retried_errors"] += 1
+        # Chaos every ~5 s: kill an actor or a whole node.
+        if time.time() - last_chaos > 5:
+            last_chaos = time.time()
+            if rng.random() < 0.5:
+                victim = rng.choice(actors)
+                victim.die.remote()
+                stats["actor_kills"] += 1
+                time.sleep(0.5)
+            else:
+                doomed = rng.choice(nodes)
+                cluster.remove_node(doomed)  # SIGKILL
+                nodes.remove(doomed)
+                stats["node_kills"] += 1
+                nodes.append(cluster.add_node(resources={"CPU": 2}))
+    # The cluster must still be fully functional at the end.
+    assert ray_tpu.get(work.remote(11), timeout=60) == 121
+    alive = 0
+    for a in actors:
+        try:
+            ray_tpu.get(a.bump.remote(), timeout=60)
+            alive += 1
+        except ray_tpu.ActorDiedError:
+            pass
+    assert alive >= len(actors) - 1, f"only {alive} actors came back"
+    assert stats["tasks"] > 0 and stats["actor_kills"] + \
+        stats["node_kills"] > 0, stats
+    print("soak stats:", stats)
+    cluster.shutdown()
