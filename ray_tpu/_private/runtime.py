@@ -191,9 +191,10 @@ class _Batcher:
     fire-and-forget sends already rely on.
     """
 
-    def __init__(self, get_conn):
+    def __init__(self, get_conn, on_fail=None):
         import queue as _queue
         self._get_conn = get_conn
+        self._on_fail = on_fail  # (addr, msgs, exc) after a failed send
         self._q: "_queue.SimpleQueue" = _queue.SimpleQueue()
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="send-batcher")
@@ -220,11 +221,15 @@ class _Batcher:
                         conn.send(msgs[0])
                     else:
                         conn.send({"kind": "msg_batch", "msgs": msgs})
-                except Exception:
+                except Exception as e:
                     logger.warning(
-                        "batched send of %d message(s) to %s failed "
-                        "(peer-close handling takes over)",
-                        len(msgs), addr)
+                        "batched send of %d message(s) to %s failed: %r",
+                        len(msgs), addr, e)
+                    if self._on_fail is not None:
+                        try:
+                            self._on_fail(addr, msgs, e)
+                        except Exception:
+                            logger.exception("batcher on_fail failed")
 
 
 class _Cell:
@@ -325,9 +330,14 @@ class Runtime:
         # borrows) objects evict in LRU order.
         from collections import OrderedDict
         self._owned: "OrderedDict[ObjectID, int]" = OrderedDict()
-        # Running byte total of _owned: summing the dict on every
-        # _make_room made put() O(n) in live objects.
+        # Running byte totals of _owned: summing the dict on every
+        # _make_room made put() O(n) in live objects. The shm-resident
+        # subset is tracked separately — the node-wide usage refresh
+        # subtracts OUR shm bytes from shm.used_bytes(), and small puts
+        # now live on the heap, not in shm.
         self._owned_bytes = 0
+        self._owned_shm_bytes = 0
+        self._owned_shm: Set[ObjectID] = set()
         self._owned_lock = threading.Lock()
         # Registered borrows, PER PEER (oid -> {peer_addr: count}):
         # per-peer floors make a stray remove_borrow (e.g. after its
@@ -482,7 +492,7 @@ class Runtime:
             on_close=self._on_head_close)
 
         # Conflating sender for the hot data plane (see _Batcher).
-        self._batcher = _Batcher(self._get_conn)
+        self._batcher = _Batcher(self._get_conn, self._on_batched_fail)
 
         from .profiling import Profiler
         self.profiler = Profiler(self, role)
@@ -522,12 +532,17 @@ class Runtime:
             serialization.write_blob(memoryview(out), meta, buffers)
             self._make_room(total)
             self.memory.put(oid, _Cell("raw", bytes(out)))
+            with self._owned_lock:
+                self._owned[oid] = total
+                self._owned_bytes += total
         else:
             self._make_room(total)
             self.shm.create_and_seal(oid, meta, buffers, total)
-        with self._owned_lock:
-            self._owned[oid] = total
-            self._owned_bytes += total
+            with self._owned_lock:
+                self._owned[oid] = total
+                self._owned_bytes += total
+                self._owned_shm_bytes += total
+                self._owned_shm.add(oid)
         return ObjectRef(oid, self.addr, total)
 
     # -- acknowledged-borrow export pins --------------------------------
@@ -616,7 +631,8 @@ class Runtime:
                     self._bytes_since_refresh > self._store_capacity // 16 \
                     or self._store_used_cache + own + incoming \
                     > self._store_capacity:
-                self._store_used_cache = self.shm.used_bytes() - own
+                self._store_used_cache = self.shm.used_bytes() \
+                    - self._owned_shm_bytes
                 if self._store_used_cache < 0:
                     self._store_used_cache = 0
                 self._store_used_dirty = False
@@ -648,6 +664,9 @@ class Runtime:
                 self._exported_at.pop(oid, None)
                 size = self._owned.pop(oid)
                 self._owned_bytes -= size
+                if oid in self._owned_shm:
+                    self._owned_shm.discard(oid)
+                    self._owned_shm_bytes -= size
                 used -= size
             over = used + incoming > self._store_capacity
         for oid in victims:
@@ -878,7 +897,11 @@ class Runtime:
             self.memory.delete(r.id)
             self.shm.delete(r.id)
             with self._owned_lock:
-                self._owned_bytes -= self._owned.pop(r.id, 0)
+                size = self._owned.pop(r.id, 0)
+                self._owned_bytes -= size
+                if r.id in self._owned_shm:
+                    self._owned_shm.discard(r.id)
+                    self._owned_shm_bytes -= size
                 self._exported_at.pop(r.id, None)
                 self._export_pins.pop(r.id, None)
             # Explicit free forfeits reconstruction — but only once EVERY
@@ -932,6 +955,8 @@ class Runtime:
                 with self._owned_lock:
                     self._owned[oid] = total
                     self._owned_bytes += total
+                    self._owned_shm_bytes += total
+                    self._owned_shm.add(oid)
                 return ArgSpec(ref=ObjectRef(oid, self.addr, total))
             out = bytearray(total)
             serialization.write_blob(memoryview(out), meta, buffers)
@@ -1038,6 +1063,17 @@ class Runtime:
         # depth flap between deep and shallow.
         self._leased_tid_addr[spec.task_id] = (
             addr, time.monotonic(), len(g.leases[addr]))
+
+    def _on_batched_fail(self, addr: str, msgs: list, exc: Exception):
+        """Failed batched send: restore the synchronous recovery the
+        direct send path had — an unreachable leased worker's tasks
+        requeue immediately instead of waiting out the head's
+        heartbeat timeout."""
+        if any(m.get("kind") == "execute_task" for m in msgs):
+            with self._lease_lock:
+                leased = addr in self._lease_by_addr
+            if leased:
+                self._on_lease_worker_lost(addr)
 
     def _push_leased(self, addr: str, spec: TaskSpec):
         spec.leased = True

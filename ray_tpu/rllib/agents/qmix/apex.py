@@ -1,0 +1,48 @@
+"""Ape-X QMIX: distributed-replay QMIX.
+
+Parity: `rllib/agents/qmix/apex.py:1` (ApexQMixTrainer) — QMIX's
+monotonic-mixing policy driven by the Ape-X architecture instead of
+single-process sync replay: sampler workers feed sharded replay actors,
+the learner consumes replay batches continuously, per-worker
+exploration epsilons (`setup_apex_exploration`). Scale knobs default an
+order of magnitude below the reference's 32-worker config so the
+trainer is runnable on one host; raise them on a real cluster.
+"""
+
+from __future__ import annotations
+
+from ...utils.config import deep_merge
+from ..dqn.apex import (apex_update_target, make_async_replay_optimizer,
+                        setup_apex_exploration)
+from ..trainer_template import build_trainer
+from .qmix import DEFAULT_CONFIG as QMIX_CONFIG
+from .qmix import QMIXPolicy
+
+APEX_QMIX_DEFAULT_CONFIG = deep_merge(deep_merge({}, QMIX_CONFIG), {
+    "optimizer": {
+        "max_weight_sync_delay": 400,
+        "num_replay_buffer_shards": 2,
+    },
+    "num_workers": 2,
+    "buffer_size": 20000,
+    "learning_starts": 200,
+    "train_batch_size": 64,
+    "rollout_fragment_length": 4,
+    "target_network_update_freq": 500,
+    "timesteps_per_iteration": 500,
+    "min_iter_time_s": 0,
+    # Replay-actor priority knobs (reference: batch_replay=True for the
+    # RNN case; this QMIX is feedforward over grouped obs, so
+    # prioritization stays available).
+    "prioritized_replay_alpha": 0.6,
+    "prioritized_replay_beta": 0.4,
+    "prioritized_replay_eps": 1e-6,
+})
+
+ApexQMIXTrainer = build_trainer(
+    name="APEX_QMIX",
+    default_policy=QMIXPolicy,
+    default_config=APEX_QMIX_DEFAULT_CONFIG,
+    make_policy_optimizer=make_async_replay_optimizer,
+    after_init=setup_apex_exploration,
+    after_optimizer_step=apex_update_target)

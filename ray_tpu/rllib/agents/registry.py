@@ -86,6 +86,16 @@ def _qmix():
     return QMIXTrainer
 
 
+def _apex_qmix():
+    from .qmix.apex import ApexQMIXTrainer
+    return ApexQMIXTrainer
+
+
+def _maddpg():
+    from ..contrib.maddpg import MADDPGTrainer
+    return MADDPGTrainer
+
+
 ALGORITHMS = {
     "PG": _pg,
     "PPO": _ppo,
@@ -104,6 +114,10 @@ ALGORITHMS = {
     "ARS": _ars,
     "MARWIL": _marwil,
     "QMIX": _qmix,
+    "APEX_QMIX": _apex_qmix,
+    # Contributed algorithms (parity: rllib/contrib registry entries).
+    "contrib/MADDPG": _maddpg,
+    "MADDPG": _maddpg,
 }
 
 

@@ -1,0 +1,1 @@
+"""Contributed algorithms (parity: `rllib/contrib/`)."""

@@ -1,0 +1,298 @@
+"""MADDPG: multi-agent DDPG with centralized critics.
+
+Parity: `rllib/contrib/maddpg/maddpg.py:1` + `maddpg_policy.py:1`
+(Lowe et al. 2017) — each agent has its own deterministic actor
+pi_i(o_i), while each critic Q_i(o_1..o_n, a_1..a_n) conditions on ALL
+agents' observations and actions (centralized training, decentralized
+execution).
+
+TPU re-architecture: the reference builds one TF policy per agent and
+shuttles every policy's sample batches to every other policy each
+update (`before_learn_on_batch`). Here the cooperative team trains
+through the grouped-env interface (like QMIX): obs [B, n, d] and joint
+actions [B, n, act_d] live in ONE batch, per-agent actor/critic
+parameters are vmap-stacked, and the entire update — n critics' TD
+losses against target actors/critics, n actor losses through their own
+critic, polyak target updates — is one donated-buffer XLA program.
+Continuous (Box) actions only; the reference's Gumbel-softmax discrete
+mode is not implemented.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+from ...parallel import mesh as mesh_lib
+from .. import sample_batch as sb
+from ..agents.dqn.dqn import make_sync_replay_optimizer
+from ..agents.trainer import with_common_config
+from ..agents.trainer_template import build_trainer
+from ..policy.policy import Policy
+from ..utils.config import deep_merge
+
+DEFAULT_CONFIG = with_common_config({
+    "actor_hiddens": [64, 64],
+    "critic_hiddens": [64, 64],
+    "actor_lr": 1e-3,
+    "critic_lr": 1e-3,
+    "tau": 0.01,
+    "gamma": 0.95,
+    "exploration_noise_sigma": 0.1,
+    "buffer_size": 50000,
+    "prioritized_replay": False,
+    "learning_starts": 500,
+    "train_batch_size": 64,
+    "rollout_fragment_length": 4,
+    "timesteps_per_iteration": 500,
+    "use_gae": False,
+})
+
+
+class _Actor(nn.Module):
+    act_dim: int
+    hiddens: tuple
+
+    @nn.compact
+    def __call__(self, obs):
+        h = obs.astype(jnp.float32)
+        for i, size in enumerate(self.hiddens):
+            h = nn.relu(nn.Dense(size, name=f"fc_{i}")(h))
+        return nn.tanh(nn.Dense(self.act_dim, name="out")(h))
+
+
+class _Critic(nn.Module):
+    hiddens: tuple
+
+    @nn.compact
+    def __call__(self, all_obs, all_actions):
+        h = jnp.concatenate(
+            [all_obs.astype(jnp.float32), all_actions], axis=-1)
+        for i, size in enumerate(self.hiddens):
+            h = nn.relu(nn.Dense(size, name=f"fc_{i}")(h))
+        return nn.Dense(1, name="q")(h)[..., 0]
+
+
+class MADDPGPolicy(Policy):
+    """Team policy over a grouped env: obs [n, d], actions [n, act_d]."""
+
+    def __init__(self, observation_space, action_space, config):
+        cfg = deep_merge(deep_merge({}, DEFAULT_CONFIG), config)
+        super().__init__(observation_space, action_space, cfg)
+        self.n_agents, self.obs_dim = observation_space.shape
+        shape = action_space.shape
+        # Per-agent Box: grouped spaces advertise either [act_d] (shared
+        # per-agent space) or [n, act_d].
+        self.act_dim = int(shape[-1]) if len(shape) else 1
+        self.act_low = float(np.min(action_space.low))
+        self.act_high = float(np.max(action_space.high))
+
+        self.actor = _Actor(self.act_dim, tuple(cfg["actor_hiddens"]))
+        self.critic = _Critic(tuple(cfg["critic_hiddens"]))
+        self.sigma = cfg["exploration_noise_sigma"]
+
+        seed = cfg.get("seed") or 0
+        self._rng = jax.random.PRNGKey(seed)
+        self._rng_i = 0
+        self._np_rng = np.random.RandomState(seed)
+
+        # Per-agent parameter stacks: vmap over per-agent init rngs
+        # (each agent gets its own actor/critic parameters, applied with
+        # a vmapped forward — the n-policies-in-one-program layout).
+        dummy_obs = np.zeros((1, self.obs_dim), np.float32)
+        dummy_all_obs = np.zeros(
+            (1, self.n_agents * self.obs_dim), np.float32)
+        dummy_all_act = np.zeros(
+            (1, self.n_agents * self.act_dim), np.float32)
+        actor_rngs = jax.random.split(self._next_rng(), self.n_agents)
+        critic_rngs = jax.random.split(self._next_rng(), self.n_agents)
+        params = {
+            "actor": jax.vmap(
+                lambda r: self.actor.init(r, dummy_obs))(actor_rngs),
+            "critic": jax.vmap(
+                lambda r: self.critic.init(
+                    r, dummy_all_obs, dummy_all_act))(critic_rngs),
+        }
+        # Separate learning rates per parameter stack (the classic
+        # MADDPG setup tunes them independently).
+        self.tx = optax.chain(
+            optax.clip_by_global_norm(cfg.get("grad_clip") or 10.0),
+            optax.multi_transform(
+                {"actor": optax.adam(cfg["actor_lr"]),
+                 "critic": optax.adam(cfg["critic_lr"])},
+                {"actor": "actor", "critic": "critic"}))
+        opt_state = self.tx.init(params)
+
+        self.mesh = cfg.get("_mesh") or mesh_lib.make_mesh(num_devices=1)
+        self._repl = mesh_lib.replicated(self.mesh)
+        self._bshard = mesh_lib.batch_sharded(self.mesh)
+        self.params = mesh_lib.put_replicated(params, self.mesh)
+        self.opt_state = mesh_lib.put_replicated(opt_state, self.mesh)
+        self._copy = jax.jit(lambda p: jax.tree.map(jnp.copy, p))
+        self.target_params = self._copy(self.params)
+
+        self._lock = threading.Lock()
+        self.global_timestep = 0
+        self._build_fns(cfg)
+
+    def _next_rng(self):
+        self._rng_i += 1
+        return jax.random.fold_in(self._rng, self._rng_i)
+
+    # ------------------------------------------------------------------
+    def _build_fns(self, cfg):
+        gamma = cfg["gamma"]
+        tau = cfg["tau"]
+        n, act_d = self.n_agents, self.act_dim
+
+        def actors(actor_params, obs):
+            # obs [B, n, d] -> actions [B, n, act_d], per-agent params.
+            return jnp.swapaxes(jax.vmap(
+                self.actor.apply, in_axes=(0, 1), out_axes=0)(
+                    actor_params, obs), 0, 1)
+
+        def critics(critic_params, obs, actions):
+            # -> per-agent Q [B, n]
+            flat_obs = obs.reshape(obs.shape[0], -1)
+            flat_act = actions.reshape(actions.shape[0], -1)
+            q = jax.vmap(self.critic.apply,
+                         in_axes=(0, None, None))(
+                             critic_params, flat_obs, flat_act)
+            return jnp.swapaxes(q, 0, 1)  # [B, n]
+
+        def loss_fn(params, target_params, batch):
+            obs, acts = batch[sb.OBS], batch[sb.ACTIONS]
+            next_obs = batch[sb.NEW_OBS]
+            rew = batch[sb.REWARDS][:, None]     # team reward -> [B, 1]
+            done = batch[sb.DONES][:, None]
+            next_acts = actors(target_params["actor"], next_obs)
+            target_q = critics(target_params["critic"], next_obs,
+                               next_acts)
+            y = rew + gamma * (1.0 - done) * target_q
+            q = critics(params["critic"], obs, acts)
+            td = q - jax.lax.stop_gradient(y)
+            critic_loss = jnp.mean(td ** 2)
+            # Actor: each agent improves ITS action through its critic,
+            # other agents' actions held at the sampled batch values.
+            pi = actors(params["actor"], obs)
+            eye = jnp.eye(n)[None, :, :, None]  # [1, n, n, 1]
+            # mixed[i] = batch actions with agent i's action replaced.
+            mixed = (eye * pi[:, None, :, :]
+                     + (1.0 - eye) * acts[:, None, :, :])  # [B, n, n, a]
+            flat_obs = obs.reshape(obs.shape[0], -1)
+            # Critic params FROZEN in the actor objective: the actor
+            # gradient must flow only through pi, not inflate Q itself
+            # (the combined-loss trap of a shared parameter tree).
+            frozen_critic = jax.lax.stop_gradient(params["critic"])
+            q_pi = jax.vmap(
+                lambda cp, m: self.critic.apply(
+                    cp, flat_obs, m.reshape(m.shape[0], -1)),
+                in_axes=(0, 1))(frozen_critic, mixed)  # [n, B]
+            actor_loss = -jnp.mean(q_pi)
+            total = critic_loss + actor_loss
+            stats = {"critic_loss": critic_loss,
+                     "actor_loss": actor_loss,
+                     "mean_q": jnp.mean(q),
+                     "td_error": jnp.mean(jnp.abs(td), axis=-1)}
+            return total, stats
+
+        def update(params, target_params, opt_state, batch):
+            (_, stats), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, target_params, batch)
+            upd, opt_state = self.tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, upd)
+            # Polyak target update fused into the same program.
+            target_params = jax.tree.map(
+                lambda t, p: (1.0 - tau) * t + tau * p,
+                target_params, params)
+            return params, target_params, opt_state, stats
+
+        self._update = jax.jit(
+            update, donate_argnums=(0, 1, 2),
+            in_shardings=(self._repl, self._repl, self._repl,
+                          self._bshard),
+            out_shardings=(self._repl, self._repl, self._repl,
+                           self._repl))
+        self._act_fn = jax.jit(
+            lambda params, obs: actors(params["actor"], obs))
+
+    # -- rollouts --------------------------------------------------------
+    def compute_actions(self, obs_batch, state_batches=None, explore=True,
+                        prev_action_batch=None, prev_reward_batch=None):
+        obs = jnp.asarray(np.asarray(obs_batch, np.float32))
+        with self._lock:
+            acts = np.asarray(self._act_fn(self.params, obs))
+        if explore:
+            acts = acts + self._np_rng.normal(
+                0.0, self.sigma, acts.shape).astype(np.float32)
+        acts = np.clip(acts, self.act_low, self.act_high)
+        self.global_timestep += len(acts)
+        return acts, [], {}
+
+    # -- learning --------------------------------------------------------
+    def _device_batch(self, batch):
+        out = {}
+        for k in (sb.OBS, sb.NEW_OBS, sb.ACTIONS, sb.REWARDS, sb.DONES):
+            v = np.asarray(batch[k])
+            if v.dtype in (np.float64, np.bool_):
+                v = v.astype(np.float32)
+            out[k] = jax.device_put(v, self._bshard)
+        return out
+
+    def learn_with_td(self, batch):
+        dev = self._device_batch(batch)
+        with self._lock:
+            self.params, self.target_params, self.opt_state, stats = \
+                self._update(self.params, self.target_params,
+                             self.opt_state, dev)
+        stats = dict(stats)
+        td = np.asarray(stats.pop("td_error"))
+        return {k: float(v) for k, v in stats.items()}, np.abs(td)
+
+    def learn_on_batch(self, batch) -> Dict:
+        stats, _ = self.learn_with_td(batch)
+        return stats
+
+    def update_target(self):
+        pass  # polyak-updated inside every learn step
+
+    # -- state -----------------------------------------------------------
+    def get_weights(self):
+        with self._lock:
+            return jax.tree.map(np.asarray, self.params)
+
+    def set_weights(self, weights):
+        with self._lock:
+            self.params = mesh_lib.put_replicated(
+                jax.tree.map(jnp.asarray, weights), self.mesh)
+
+    def get_state(self):
+        with self._lock:
+            return {
+                "weights": jax.tree.map(np.asarray, self.params),
+                "target": jax.tree.map(np.asarray, self.target_params),
+                "opt_state": jax.tree.map(np.asarray, self.opt_state),
+                "global_timestep": self.global_timestep,
+            }
+
+    def set_state(self, state):
+        self.set_weights(state["weights"])
+        with self._lock:
+            self.target_params = mesh_lib.put_replicated(
+                jax.tree.map(jnp.asarray, state["target"]), self.mesh)
+            self.opt_state = mesh_lib.put_replicated(
+                jax.tree.map(jnp.asarray, state["opt_state"]), self.mesh)
+        self.global_timestep = state.get("global_timestep", 0)
+
+
+MADDPGTrainer = build_trainer(
+    name="contrib/MADDPG",
+    default_policy=MADDPGPolicy,
+    default_config=DEFAULT_CONFIG,
+    make_policy_optimizer=make_sync_replay_optimizer)

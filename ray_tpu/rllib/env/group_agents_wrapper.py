@@ -42,8 +42,12 @@ class GroupedMultiAgentEnv:
         return self._stack(obs)
 
     def step(self, action_vec):
-        actions = {aid: int(action_vec[i])
-                   for i, aid in enumerate(self._ids)}
+        if isinstance(self.action_space, Discrete):
+            actions = {aid: int(action_vec[i])
+                       for i, aid in enumerate(self._ids)}
+        else:  # per-agent Box: one action row per agent
+            actions = {aid: np.asarray(action_vec[i], np.float32)
+                       for i, aid in enumerate(self._ids)}
         obs, rew, done, info = self.env.step(actions)
         team_reward = float(sum(rew.values()))
         return (self._stack(obs), team_reward,
@@ -54,6 +58,49 @@ class GroupedMultiAgentEnv:
 
     def seed(self, seed=None):
         self.env.seed(seed)
+
+
+class SpreadGame(MultiAgentEnv):
+    """Cooperative continuous control for MADDPG: every agent observes
+    the shared target vector t and must output its own component; the
+    TEAM reward couples all agents (-sum_i (a_i - t_i)^2), so credit
+    assignment needs the centralized critic (parity role:
+    `rllib/contrib/maddpg`'s simple_spread usage)."""
+
+    def __init__(self, n_agents: int = 2, episode_len: int = 5,
+                 seed=None):
+        self.n_agents = n_agents
+        self.episode_len = episode_len
+        self.observation_space = Box(-1.0, 1.0, shape=(n_agents,))
+        self.action_space = Box(-1.0, 1.0, shape=(1,))
+        self._rng = np.random.default_rng(seed)
+        self._t = 0
+
+    def seed(self, seed=None):
+        self._rng = np.random.default_rng(seed)
+
+    def _obs(self):
+        return {i: self._target.astype(np.float32)
+                for i in range(self.n_agents)}
+
+    def reset(self):
+        self._t = 0
+        self._target = self._rng.uniform(
+            -0.8, 0.8, self.n_agents).astype(np.float32)
+        return self._obs()
+
+    def step(self, actions):
+        self._t += 1
+        a = np.array([float(np.asarray(actions[i]).reshape(-1)[0])
+                      for i in range(self.n_agents)], np.float32)
+        team = -float(np.sum((a - self._target) ** 2))
+        self._target = self._rng.uniform(
+            -0.8, 0.8, self.n_agents).astype(np.float32)
+        done = self._t >= self.episode_len
+        share = team / self.n_agents
+        return (self._obs(),
+                {i: share for i in range(self.n_agents)},
+                {"__all__": done}, {})
 
 
 class TwoStepGame(MultiAgentEnv):

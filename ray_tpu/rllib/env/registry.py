@@ -166,6 +166,17 @@ def _two_step_game_grouped(cfg):
 register_env("GroupedTwoStepGame-v0", _two_step_game_grouped)
 
 
+def _spread_grouped(cfg):
+    from .group_agents_wrapper import GroupedMultiAgentEnv, SpreadGame
+    n = cfg.get("n_agents", 2)
+    return GroupedMultiAgentEnv(
+        SpreadGame(n_agents=n, episode_len=cfg.get("episode_len", 5),
+                   seed=cfg.get("seed")), n_agents=n)
+
+
+register_env("GroupedSpread-v0", _spread_grouped)
+
+
 # ALE-shaped Catch (env/ale_catch.py): the ROM-free env that exercises
 # the full DeepMind preprocessing stack (atari_wrappers.py).
 def _ale_catch(framestack):

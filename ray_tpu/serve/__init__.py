@@ -1,5 +1,7 @@
-from .api import (create_backend, create_endpoint, get_handle, init, link,
-                  set_traffic, shutdown)
+from .api import (RoutePolicy, create_backend, create_endpoint,
+                  get_backend_config, get_handle, init, link, set_traffic,
+                  shutdown, stat, update_backend_config)
 
-__all__ = ["create_backend", "create_endpoint", "get_handle", "init",
-           "link", "set_traffic", "shutdown"]
+__all__ = ["RoutePolicy", "create_backend", "create_endpoint",
+           "get_backend_config", "get_handle", "init", "link",
+           "set_traffic", "shutdown", "stat", "update_backend_config"]

@@ -2,21 +2,43 @@
 
 Parity: `python/ray/experimental/serve/api.py:62` — `init`,
 `create_backend` (:204), `create_endpoint` (:137), `set_traffic`,
-`get_handle`; backends are replica actors, endpoints route HTTP and
-Python calls to backends by traffic weights (reference: router queues in
-`serve/queues.py` + flask frontend in `serve/server.py`; here the
-router is one actor embedding a stdlib HTTP server thread, and replica
-fan-out uses round-robin over actor handles).
+`get_handle`, plus the router/queue layer of `serve/queues.py` and the
+policy registry of `serve/policy.py`:
+
+- Each backend has replica actors with a bounded number of in-flight
+  queries (`max_concurrent_queries`); excess requests BUFFER in the
+  router and dispatch as replicas free up (the reference's
+  CentralizedQueues buffer_queues — backpressure instead of unbounded
+  fan-out).
+- `RoutePolicy` selects the backend among an endpoint's
+  traffic-weighted candidates: Random (weighted sampling),
+  RoundRobin, PowerOfTwo (sample two by weight, take the one with the
+  shorter queue), FixedPacking (fill one backend up to `packing_num`
+  before moving on) — the four policies of `serve/policy.py:15`.
+- Within a backend, the least-loaded replica serves the query.
 """
 
 from __future__ import annotations
 
+import itertools
 import json
+import random
+import threading
+from enum import Enum
 from typing import Any, Callable, Dict, Optional
 
 import ray_tpu
 
 _router = None
+
+
+class RoutePolicy(Enum):
+    """Backend selection policy (parity: `serve/policy.py:8`)."""
+
+    Random = "random"
+    RoundRobin = "round-robin"
+    PowerOfTwo = "power-of-two"
+    FixedPacking = "fixed-packing"
 
 
 class _Replica:
@@ -38,46 +60,112 @@ class _Replica:
 
 
 class _Router:
-    """Endpoint/backend tables + HTTP frontend (one per serve instance)."""
+    """Endpoint/backend tables, policy routing, bounded replica queues,
+    HTTP frontend (one per serve instance)."""
 
     def __init__(self, http_host: str, http_port: int):
         self.endpoints: Dict[str, dict] = {}   # name -> {route, traffic}
-        self.backends: Dict[str, list] = {}    # name -> [replica handles]
+        self.backends: Dict[str, dict] = {}    # name -> backend record
         self.routes: Dict[str, str] = {}       # route -> endpoint
-        self._rr: Dict[str, int] = {}
+        self._lock = threading.Lock()
+        self._free = threading.Condition(self._lock)
+        self._rr: Dict[str, "itertools.cycle"] = {}
+        self._packing: Dict[str, list] = {}  # endpoint -> [backend, left]
         self._http_addr = None
         self._start_http(http_host, http_port)
 
     # -- control plane ---------------------------------------------------
-    def create_endpoint(self, name: str, route: Optional[str]):
-        self.endpoints[name] = {"route": route, "traffic": {}}
+    def create_endpoint(self, name: str, route: Optional[str],
+                        policy: str = RoutePolicy.Random.value,
+                        packing_num: int = 3):
+        self.endpoints[name] = {"route": route, "traffic": {},
+                                "policy": policy,
+                                "packing_num": packing_num}
         if route:
             self.routes[route] = name
         return "ok"
 
     def create_backend(self, name: str, func_or_class_bytes, args,
-                       kwargs, num_replicas: int):
+                       kwargs, num_replicas: int,
+                       max_concurrent_queries: int = 8):
+        # Replicas are num_cpus=0 actors: serving concurrency is
+        # governed by max_concurrent_queries, not the CPU vector (same
+        # as env actors in remote_vector_env.py).
         cls = ray_tpu.remote(_Replica)
-        self.backends[name] = [
-            cls.remote(func_or_class_bytes, list(args), dict(kwargs))
-            for _ in range(num_replicas)]
+        with self._lock:
+            self.backends[name] = {
+                "factory": (func_or_class_bytes, list(args),
+                            dict(kwargs)),
+                # Replica records carry their own outstanding counter:
+                # releases key on the RECORD (identity), so a query
+                # finishing on a scaled-away replica can never corrupt
+                # a newer replica's counter.
+                "replicas": [
+                    {"handle": cls.options(num_cpus=0).remote(
+                        func_or_class_bytes, list(args), dict(kwargs)),
+                     "outstanding": 0}
+                    for _ in range(num_replicas)],
+                "max_concurrent_queries": max_concurrent_queries,
+            }
         return "ok"
+
+    def update_backend_config(self, name: str, config: Dict[str, Any]):
+        """Scale replicas / adjust concurrency live (parity:
+        api.py set_backend_config + queue reconfiguration)."""
+        with self._lock:
+            b = self.backends[name]
+            if "max_concurrent_queries" in config:
+                b["max_concurrent_queries"] = int(
+                    config["max_concurrent_queries"])
+            target = config.get("num_replicas")
+            if target is not None:
+                cur = len(b["replicas"])
+                if target > cur:
+                    cls = ray_tpu.remote(_Replica)
+                    fb, fa, fk = b["factory"]
+                    for _ in range(target - cur):
+                        b["replicas"].append(
+                            {"handle": cls.options(num_cpus=0).remote(
+                                fb, list(fa), dict(fk)),
+                             "outstanding": 0})
+                elif target < cur:
+                    for r in b["replicas"][target:]:
+                        try:
+                            ray_tpu.kill(r["handle"])
+                        except Exception:
+                            pass
+                    del b["replicas"][target:]
+            self._free.notify_all()
+        return "ok"
+
+    def get_backend_config(self, name: str) -> Dict[str, Any]:
+        with self._lock:
+            b = self.backends[name]
+            return {"num_replicas": len(b["replicas"]),
+                    "max_concurrent_queries":
+                        b["max_concurrent_queries"]}
 
     def set_traffic(self, endpoint: str, traffic: Dict[str, float]):
         total = sum(traffic.values())
-        self.endpoints[endpoint]["traffic"] = {
-            b: w / total for b, w in traffic.items()}
+        with self._lock:
+            self.endpoints[endpoint]["traffic"] = {
+                b: w / total for b, w in traffic.items()}
+            self._rr.pop(endpoint, None)
+            self._packing.pop(endpoint, None)
         return "ok"
 
     def http_address(self):
         return self._http_addr
 
+    def queue_stats(self) -> Dict[str, dict]:
+        with self._lock:
+            return {name: {"outstanding": sum(
+                               r["outstanding"] for r in b["replicas"]),
+                           "replicas": len(b["replicas"])}
+                    for name, b in self.backends.items()}
+
     # -- data plane ------------------------------------------------------
-    def _pick_backend(self, endpoint: str) -> str:
-        import random
-        traffic = self.endpoints[endpoint]["traffic"]
-        if not traffic:
-            raise ValueError(f"endpoint {endpoint!r} has no traffic")
+    def _weighted_pick(self, traffic: Dict[str, float]) -> str:
         r = random.random()
         acc = 0.0
         for backend, w in traffic.items():
@@ -86,16 +174,72 @@ class _Router:
                 return backend
         return next(iter(traffic))
 
+    def _pick_backend_locked(self, endpoint: str) -> str:
+        ep = self.endpoints[endpoint]
+        traffic = ep["traffic"]
+        if not traffic:
+            raise ValueError(f"endpoint {endpoint!r} has no traffic")
+        policy = ep["policy"]
+        if policy == RoutePolicy.RoundRobin.value:
+            cyc = self._rr.get(endpoint)
+            if cyc is None:
+                cyc = self._rr[endpoint] = itertools.cycle(
+                    sorted(traffic))
+            return next(cyc)
+        if policy == RoutePolicy.PowerOfTwo.value:
+            a = self._weighted_pick(traffic)
+            b = self._weighted_pick(traffic)
+            load = {n: sum(r["outstanding"]
+                           for r in self.backends[n]["replicas"])
+                    if n in self.backends else 0 for n in (a, b)}
+            return min((a, b), key=lambda n: load[n])
+        if policy == RoutePolicy.FixedPacking.value:
+            state = self._packing.get(endpoint)
+            if not state or state[1] <= 0 or state[0] not in traffic:
+                state = [self._weighted_pick(traffic),
+                         ep["packing_num"]]
+                self._packing[endpoint] = state
+            state[1] -= 1
+            return state[0]
+        return self._weighted_pick(traffic)  # Random
+
+    def _acquire_replica(self, backend: str):
+        """Block until a replica of `backend` has a free query slot;
+        returns the replica RECORD. This is the bounded buffer: callers
+        (router threads) wait here instead of over-dispatching. Note
+        the capacity coupling: buffered requests hold router actor
+        threads, so the router's max_concurrency bounds total buffered
+        + in-flight queries across all backends."""
+        with self._free:
+            while True:
+                b = self.backends.get(backend)
+                if b is None:
+                    raise ValueError(f"unknown backend {backend!r}")
+                cap = b["max_concurrent_queries"]
+                if b["replicas"]:
+                    rec = min(b["replicas"],
+                              key=lambda r: r["outstanding"])
+                    if rec["outstanding"] < cap:
+                        rec["outstanding"] += 1
+                        return rec
+                self._free.wait(1.0)
+
+    def _release_replica(self, rec: dict):
+        with self._free:
+            rec["outstanding"] -= 1
+            self._free.notify_all()
+
     def route_call(self, endpoint: str, request):
-        backend = self._pick_backend(endpoint)
-        replicas = self.backends[backend]
-        i = self._rr.get(backend, 0)
-        self._rr[backend] = (i + 1) % len(replicas)
-        return ray_tpu.get(replicas[i].handle.remote(request))
+        with self._lock:
+            backend = self._pick_backend_locked(endpoint)
+        rec = self._acquire_replica(backend)
+        try:
+            return ray_tpu.get(rec["handle"].handle.remote(request))
+        finally:
+            self._release_replica(rec)
 
     # -- HTTP frontend ---------------------------------------------------
     def _start_http(self, host: str, port: int):
-        import threading
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         router = self
@@ -146,7 +290,7 @@ def init(http_host: str = "127.0.0.1", http_port: int = 0) -> str:
     global _router
     if _router is None:
         _router = ray_tpu.remote(_Router).options(
-            max_concurrency=16).remote(http_host, http_port)
+            max_concurrency=256).remote(http_host, http_port)
     return ray_tpu.get(_router.http_address.remote())
 
 
@@ -156,16 +300,34 @@ def _require_router():
     return _router
 
 
-def create_endpoint(name: str, route: Optional[str] = None):
-    ray_tpu.get(_require_router().create_endpoint.remote(name, route))
+def create_endpoint(name: str, route: Optional[str] = None,
+                    policy: RoutePolicy = RoutePolicy.Random,
+                    packing_num: int = 3):
+    ray_tpu.get(_require_router().create_endpoint.remote(
+        name, route, policy.value, packing_num))
 
 
 def create_backend(name: str, func_or_class: Callable, *args,
-                   num_replicas: int = 1, **kwargs):
+                   num_replicas: int = 1,
+                   max_concurrent_queries: int = 8, **kwargs):
     import cloudpickle
     ray_tpu.get(_require_router().create_backend.remote(
         name, cloudpickle.dumps(func_or_class), args, kwargs,
-        num_replicas))
+        num_replicas, max_concurrent_queries))
+
+
+def update_backend_config(name: str, config: Dict[str, Any]):
+    ray_tpu.get(_require_router().update_backend_config.remote(
+        name, config))
+
+
+def get_backend_config(name: str) -> Dict[str, Any]:
+    return ray_tpu.get(_require_router().get_backend_config.remote(name))
+
+
+def stat() -> Dict[str, dict]:
+    """Per-backend queue depth/replica counts (parity: _serve_metric)."""
+    return ray_tpu.get(_require_router().queue_stats.remote())
 
 
 def set_traffic(endpoint: str, traffic: Dict[str, float]):

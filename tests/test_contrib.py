@@ -1,0 +1,95 @@
+"""Contributed algorithms: MADDPG (centralized critics) + APEX_QMIX.
+
+Parity: `rllib/contrib/maddpg/` and `rllib/agents/qmix/apex.py`, via
+the registry names the reference uses ("contrib/MADDPG", "APEX_QMIX").
+"""
+
+import numpy as np
+import pytest
+
+import ray_tpu
+from ray_tpu.rllib.agents.registry import get_trainer_class
+
+
+@pytest.fixture
+def ray_session():
+    ray_tpu.init(num_cpus=2)
+    yield ray_tpu
+    ray_tpu.shutdown()
+
+
+class TestMADDPG:
+    def test_learns_cooperative_spread(self, ray_session):
+        """Team reward is -sum_i (a_i - t_i)^2 per step (5 steps per
+        episode): random play scores ~-2.2/episode for 2 agents; a
+        working centralized-critic learner approaches 0."""
+        t = get_trainer_class("contrib/MADDPG")(config={
+            "env": "GroupedSpread-v0",
+            "env_config": {"n_agents": 2, "seed": 0},
+            "num_workers": 0,
+            "learning_starts": 300,
+            "train_batch_size": 64,
+            "rollout_fragment_length": 4,
+            "timesteps_per_iteration": 400,
+            "actor_lr": 2e-3,
+            "critic_lr": 2e-3,
+            "seed": 0,
+        })
+        best = -np.inf
+        for _ in range(30):
+            r = t.train()
+            rew = r.get("episode_reward_mean")
+            if rew == rew and rew is not None:
+                best = max(best, rew)
+            if best > -0.35:
+                break
+        t.stop()
+        assert best > -0.35, f"MADDPG failed to learn spread: {best}"
+
+    def test_checkpoint_roundtrip(self, ray_session, tmp_path):
+        cls = get_trainer_class("MADDPG")
+        cfg = {"env": "GroupedSpread-v0", "num_workers": 0,
+               "learning_starts": 100, "train_batch_size": 32,
+               "timesteps_per_iteration": 150, "seed": 0}
+        t1 = cls(config=dict(cfg))
+        t1.train()
+        path = t1.save(str(tmp_path))
+        t2 = cls(config=dict(cfg))
+        t2.restore(path)
+        import jax
+        jax.tree.map(
+            lambda a, b: np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b)),
+            t1.get_policy().get_weights(), t2.get_policy().get_weights())
+        t1.stop()
+        t2.stop()
+
+
+class TestApexQMIX:
+    def test_trains_two_step_game(self, ray_session):
+        """APEX_QMIX end to end on the QMIX coordination game with
+        remote sampler workers + sharded replay actors."""
+        t = get_trainer_class("APEX_QMIX")(config={
+            "env": "GroupedTwoStepGame-v0",
+            "num_workers": 2,
+            "optimizer": {"num_replay_buffer_shards": 2,
+                          "max_weight_sync_delay": 100},
+            "buffer_size": 5000,
+            "learning_starts": 100,
+            "train_batch_size": 32,
+            "rollout_fragment_length": 4,
+            "target_network_update_freq": 200,
+            "timesteps_per_iteration": 200,
+            "min_iter_time_s": 0,
+            "seed": 0,
+        })
+        reward = None
+        for _ in range(12):
+            r = t.train()
+            if r.get("episode_reward_mean") is not None:
+                reward = r["episode_reward_mean"]
+        t.stop()
+        # Learning-to-optimum (8.0) is QMIX's job and covered by the
+        # QMIX tests; here the distributed-replay plumbing must sample,
+        # replay, and train without losing the signal entirely.
+        assert reward is not None and reward > 5.0, reward

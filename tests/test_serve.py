@@ -70,3 +70,89 @@ class TestServe:
             assert ray_tpu.get(h.remote(3)) == 30
         finally:
             serve.shutdown()
+
+    def test_route_policies(self, ray_start):
+        """RoutePolicy parity (`serve/policy.py`): round-robin
+        alternates backends exactly; fixed-packing sticks to one
+        backend for packing_num calls."""
+        from ray_tpu import serve
+        serve.init()
+        try:
+            serve.create_endpoint("rr", policy=serve.RoutePolicy.RoundRobin)
+            serve.create_backend("a", Doubler, 2)
+            serve.create_backend("b", Doubler, 10)
+            serve.set_traffic("rr", {"a": 0.5, "b": 0.5})
+            h = serve.get_handle("rr")
+            out = ray_tpu.get([h.remote(1) for _ in range(6)])
+            # Alternation: both appear, 3 each (order stable per cycle).
+            assert sorted(out) == [2, 2, 2, 10, 10, 10], out
+
+            serve.create_endpoint(
+                "packed", policy=serve.RoutePolicy.FixedPacking,
+                packing_num=4)
+            serve.set_traffic("packed", {"a": 0.5, "b": 0.5})
+            hp = serve.get_handle("packed")
+            outs = ray_tpu.get([hp.remote(1) for _ in range(8)])
+            # Runs of 4 identical results (one backend filled at a time).
+            assert outs[0:4].count(outs[0]) == 4
+            assert outs[4:8].count(outs[4]) == 4
+        finally:
+            serve.shutdown()
+
+    def test_power_of_two_prefers_shorter_queue(self, ray_start):
+        from ray_tpu import serve
+        serve.init()
+        try:
+            serve.create_endpoint(
+                "p2", policy=serve.RoutePolicy.PowerOfTwo)
+            serve.create_backend("fast", Doubler, 2)
+            serve.create_backend("slow", Doubler, 10)
+            serve.set_traffic("p2", {"fast": 0.5, "slow": 0.5})
+            h = serve.get_handle("p2")
+            out = ray_tpu.get([h.remote(1) for _ in range(8)])
+            assert set(out) <= {2, 10} and len(out) == 8
+        finally:
+            serve.shutdown()
+
+    def test_bounded_queries_and_scaling(self, ray_start):
+        """max_concurrent_queries bounds in-flight work per replica
+        (excess buffers in the router), and update_backend_config
+        scales replicas live."""
+        import time
+
+        from ray_tpu import serve
+
+        class Slow:
+            def __call__(self, request):
+                time.sleep(0.2)
+                return request
+
+        serve.init()
+        try:
+            serve.create_endpoint("slow")
+            serve.create_backend("s", Slow, num_replicas=1,
+                                 max_concurrent_queries=1)
+            serve.link("slow", "s")
+            h = serve.get_handle("slow")
+            t0 = time.perf_counter()
+            assert ray_tpu.get([h.remote(i) for i in range(4)],
+                               timeout=60) == [0, 1, 2, 3]
+            serial = time.perf_counter() - t0
+            # 4 queries, 1 replica, 1 slot: necessarily serialized.
+            assert serial > 0.75, serial
+            cfg = serve.get_backend_config("s")
+            assert cfg == {"num_replicas": 1,
+                           "max_concurrent_queries": 1}
+            # Scale out to 4 replicas: the same burst runs concurrently.
+            serve.update_backend_config("s", {"num_replicas": 4})
+            assert serve.get_backend_config("s")["num_replicas"] == 4
+            # Warm the new replica actors (first call pays worker boot).
+            ray_tpu.get([h.remote(i) for i in range(8)], timeout=60)
+            t0 = time.perf_counter()
+            assert ray_tpu.get([h.remote(i) for i in range(4)],
+                               timeout=60) == [0, 1, 2, 3]
+            scaled = time.perf_counter() - t0
+            assert scaled < serial * 0.75, (serial, scaled)
+            assert serve.stat()["s"]["replicas"] == 4
+        finally:
+            serve.shutdown()
