@@ -49,7 +49,9 @@ def make_async_optimizer(workers, config):
         inline_env_config=config.get("env_config"),
         inline_seed=config.get("seed"),
         device_rollouts=config.get("device_rollouts", "auto"),
-        device_frame_stack=config.get("device_frame_stack", 0))
+        device_frame_stack=config.get("device_frame_stack", 0),
+        obs_delta=config.get("obs_delta", "auto"),
+        obs_delta_budget=config.get("obs_delta_budget", 256))
 
 
 def validate_config(config):

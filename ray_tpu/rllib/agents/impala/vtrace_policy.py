@@ -58,6 +58,14 @@ DEFAULT_CONFIG = with_common_config({
     # Stack depth for on-device frame stacking (0 = off). Requires an
     # env that emits single-channel frames (see device_frame_stack.py).
     "device_frame_stack": 0,
+    # Delta-encoded observation uploads (`env/delta_obs.py`): the device
+    # retains the frame batch; the host ships only changed pixels.
+    # "auto" = envs with native delta support; True also wraps other
+    # frame envs in the generic host-side `DeltaEncoder`; False = off.
+    "obs_delta": "auto",
+    # Max changed pixels per env-row before falling back to a full-frame
+    # row (generic DeltaEncoder only; native envs set their own budget).
+    "obs_delta_budget": 256,
 })
 
 

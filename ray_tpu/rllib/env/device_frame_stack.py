@@ -53,6 +53,12 @@ class DeviceFrameStack(BatchedEnv):
         self.num_envs = inner.num_envs
         self.observation_space = stacked_space(inner.observation_space, k)
         self.action_space = inner.action_space
+        # Delta protocol passthrough (env/delta_obs.py): the sampler
+        # keys on `delta_budget` to enable delta-encoded uploads.
+        if hasattr(inner, "delta_budget"):
+            self.delta_budget = inner.delta_budget
+            self.vector_reset_delta = inner.vector_reset_delta
+            self.vector_step_delta = inner.vector_step_delta
 
     def vector_reset(self):
         return self.inner.vector_reset()

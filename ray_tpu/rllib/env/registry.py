@@ -78,13 +78,17 @@ def register_batched_env(name: str, creator: Callable) -> None:
 
 
 def make_batched_env(name, num_envs: int, env_config: dict = None,
-                     seed=None, device_frame_stack: int = 0):
+                     seed=None, device_frame_stack: int = 0,
+                     obs_delta=False, obs_delta_budget: int = 256):
     """Build a BatchedEnv for `name` (string id or env creator callable).
 
     Uses the natively-vectorized implementation when one is registered;
     otherwise wraps N single-env instances (`BatchedEnvFromSingle`).
     With `device_frame_stack=k` the env must emit single-channel frames;
     they are wrapped for on-device stacking (`device_frame_stack.py`).
+    With `obs_delta=True`, envs without native delta support gain the
+    generic host-side `DeltaEncoder` (`delta_obs.py`); "auto" keeps
+    native support only.
     """
     from .batched_env import BatchedEnvFromSingle
     env_config = env_config or {}
@@ -95,6 +99,9 @@ def make_batched_env(name, num_envs: int, env_config: dict = None,
             lambda: make_env(name, env_config), num_envs)
     else:  # creator callable
         env = BatchedEnvFromSingle(lambda: name(env_config), num_envs)
+    if obs_delta is True and not hasattr(env, "delta_budget"):
+        from .delta_obs import DeltaEncoder
+        env = DeltaEncoder(env, budget=obs_delta_budget)
     if device_frame_stack:
         from .device_frame_stack import DeviceFrameStack
         env = DeviceFrameStack(env, device_frame_stack)
@@ -125,6 +132,21 @@ register_batched_env("SyntheticAtari-v0", _batched_synthetic_atari(4))
 # Single-frame emission variant for on-device frame stacking (pair with
 # config device_frame_stack=4; see env/device_frame_stack.py).
 register_batched_env("SyntheticAtariFrames-v0", _batched_synthetic_atari(1))
+
+
+def _batched_sprite_atari(n, cfg):
+    from .delta_obs import BatchedSpriteAtari
+    return BatchedSpriteAtari(
+        n, episode_len=cfg.get("episode_len", 1000),
+        num_actions=cfg.get("num_actions", 6),
+        pool_size=cfg.get("pool_size", 16),
+        speed=cfg.get("speed", 3))
+
+
+# Temporally-coherent Atari-shaped frames with native delta emission
+# (env/delta_obs.py): single-channel, pair with device_frame_stack=4 and
+# obs_delta="auto" on the inline-actor path.
+register_batched_env("SpriteAtari-v0", _batched_sprite_atari)
 register_batched_env("CartPole-v0", _batched_cartpole(200))
 register_batched_env("CartPole-v1", _batched_cartpole(500))
 
@@ -147,6 +169,18 @@ register_env("SyntheticAtariFrames-v0",
                  episode_len=cfg.get("episode_len", 1000),
                  num_actions=cfg.get("num_actions", 6),
                  channels=1))
+
+
+def _sprite_atari(cfg):
+    from .delta_obs import SpriteAtari
+    return SpriteAtari(
+        episode_len=cfg.get("episode_len", 1000),
+        num_actions=cfg.get("num_actions", 6),
+        pool_size=cfg.get("pool_size", 16),
+        speed=cfg.get("speed", 3))
+
+
+register_env("SpriteAtari-v0", _sprite_atari)
 
 
 def _multiagent_cartpole(cfg):

@@ -22,6 +22,14 @@ staging layer is `rllib/optimizers/aso_multi_gpu_learner.py:140`
 - Inference for step t+1 is dispatched BEFORE step t's host bookkeeping
   (async JAX dispatch), so the upload/compute overlaps env stepping —
   the double-buffering the r3 verdict asked for.
+- DELTA MODE (round 5; see `env/delta_obs.py`): when the env supports
+  the delta protocol, the device retains the current frame batch in HBM
+  and the host uploads only changed pixels ([N, K] uint16 indices +
+  uint8 values, one XLA scatter) — full-frame rows only for resets and
+  over-budget rows. For Atari-statistics frames this cuts per-step
+  upload bytes ~9x below even the single-frame mode, which is what the
+  15k steps/s/chip anchor requires of a multi-MB/s host->device link
+  (VERDICT.md r4 next #1).
 
 Byte/time accounting is kept on the instance (`bytes_h2d`, `bytes_d2h`,
 `t_fetch`, `t_env`) so `bench.py` can print a per-stage bandwidth
@@ -54,7 +62,8 @@ class DeviceSebulbaSampler:
     def __init__(self, batched_env, policy,
                  rollout_fragment_length: int,
                  explore: bool = True,
-                 eps_id_offset: int = 0):
+                 eps_id_offset: int = 0,
+                 use_delta: bool = True):
         if getattr(policy, "recurrent", False):
             raise ValueError(
                 "DeviceSebulbaSampler supports feedforward policies only")
@@ -64,12 +73,10 @@ class DeviceSebulbaSampler:
         self.explore = explore
         self.frame_stack = int(getattr(
             batched_env, "device_frame_stack", 0))
+        self.delta = bool(use_delta
+                          and hasattr(batched_env, "delta_budget"))
         n = self.env.num_envs
         self._n = n
-        # Initial obs: in frame mode the env emits [N, H, W, 1]; dones
-        # start True so the first fused step reset-fills the stacks.
-        self._host_obs = np.asarray(self.env.vector_reset())
-        self._host_done = np.ones(n, bool)
         self._ep_rew = np.zeros(n, np.float64)
         self._ep_len = np.zeros(n, np.int64)
         self._eps_counter = eps_id_offset
@@ -79,6 +86,14 @@ class DeviceSebulbaSampler:
         # Pending fused-step outputs for the CURRENT observation
         # (dispatched by the previous loop turn / previous sample call).
         self._pending = None
+        self._host_done = np.ones(n, bool)
+        # ---- transfer accounting (read by bench.py) ------------------
+        self.bytes_h2d = 0       # delta entries / frames + flags shipped
+        self.bytes_d2h = 0       # action arrays fetched down
+        self.t_fetch = 0.0       # host blocked waiting for actions
+        self.t_env = 0.0         # host inside env.vector_step
+        self.steps_total = 0
+
         if self.frame_stack:
             space = self.env.observation_space
             self._stack = jax.device_put(
@@ -86,21 +101,32 @@ class DeviceSebulbaSampler:
                 policy._bsharded)
         else:
             self._stack = None
+
+        if self.delta:
+            frame_space = getattr(self.env, "inner", self.env)\
+                .observation_space
+            fs = frame_space.shape
+            self._frame_shape = fs
+            self._hw = int(np.prod(fs))
+            self._full_fns = {}
+            ds = self.env.vector_reset_delta()
+            self._frames_d = jax.device_put(
+                np.ascontiguousarray(ds.full_frames), policy._bsharded)
+            self.bytes_h2d += ds.full_frames.nbytes
+            self._host_delta = None
+        else:
+            self._host_obs = np.asarray(self.env.vector_reset())
         self._build_fns()
-        # ---- transfer accounting (read by bench.py) ------------------
-        self.bytes_h2d = 0       # frames + done flags shipped up
-        self.bytes_d2h = 0       # action arrays fetched down
-        self.t_fetch = 0.0       # host blocked waiting for actions
-        self.t_env = 0.0         # host inside env.vector_step
-        self.steps_total = 0
 
     # ------------------------------------------------------------------
     def _build_fns(self):
         policy = self.policy
         S = self.frame_stack
 
-        if S:
-            def step_fn(params, stack, frame, done, rng, explore):
+        def stack_and_infer(params, stack, frame, done, rng, explore):
+            """frame: [N, H, W, C] newest observation. Returns the fused
+            (actions, logp, dist_inputs, value, obs)."""
+            if S:
                 # Episode boundary: the stack restarts filled with the
                 # new episode's first frame (host FrameStack semantics,
                 # reference `atari_wrappers.py` FrameStack.reset).
@@ -110,44 +136,103 @@ class DeviceSebulbaSampler:
                     [stack[..., 1:], frame.astype(stack.dtype)], axis=-1)
                 obs = jnp.where(
                     done[:, None, None, None], filled, rolled)
-                dist_inputs, value = policy.apply(params, obs)
-                dist = policy.dist_class(dist_inputs)
-                actions = jax.lax.cond(
-                    explore,
-                    lambda: dist.sample(rng),
-                    lambda: dist.deterministic_sample())
-                logp = dist.logp(actions)
-                return actions, logp, dist_inputs, value, obs
-        else:
-            def step_fn(params, stack, obs, done, rng, explore):
-                dist_inputs, value = policy.apply(params, obs)
-                dist = policy.dist_class(dist_inputs)
-                actions = jax.lax.cond(
-                    explore,
-                    lambda: dist.sample(rng),
-                    lambda: dist.deterministic_sample())
-                logp = dist.logp(actions)
-                return actions, logp, dist_inputs, value, obs
+            else:
+                obs = frame
+            dist_inputs, value = policy.apply(params, obs)
+            dist = policy.dist_class(dist_inputs)
+            actions = jax.lax.cond(
+                explore,
+                lambda: dist.sample(rng),
+                lambda: dist.deterministic_sample())
+            logp = dist.logp(actions)
+            return actions, logp, dist_inputs, value, obs
 
-        self._step_fn = jax.jit(step_fn, static_argnums=())
+        if self.delta:
+            shape = self._frame_shape
+
+            def delta_step_fn(params, stack, frames, idx, val, done, rng,
+                              explore):
+                # frames: [N, HW] uint8 retained on device; idx/val:
+                # [N, K] sparse delta (pad idx == HW dropped).
+                n = frames.shape[0]
+                frames = frames.at[
+                    jnp.arange(n)[:, None], idx.astype(jnp.int32)].set(
+                        val, mode="drop")
+                frame = frames.reshape((n,) + shape)
+                out = stack_and_infer(
+                    params, stack, frame, done, rng, explore)
+                return out + (frames,)
+
+            # frames (arg 2) is donated: the old frame buffer is dead
+            # once the new one exists; saves an HBM copy per step.
+            self._step_fn = jax.jit(delta_step_fn, donate_argnums=(2,))
+        else:
+            self._step_fn = jax.jit(stack_and_infer)
+
+    def _full_fn(self, b: int):
+        """Bucketed whole-row replacement: rows [b] int32 (pad == N,
+        dropped), fulls [b, HW] uint8."""
+        if b not in self._full_fns:
+            def apply_full(frames, rows, fulls):
+                return frames.at[rows].set(fulls, mode="drop")
+            self._full_fns[b] = jax.jit(
+                apply_full, donate_argnums=(0,))
+        return self._full_fns[b]
 
     def _dispatch_step(self):
-        """Upload the current frame batch and dispatch fused inference.
+        """Upload the newest env output and dispatch fused inference.
 
         Returns immediately (async JAX dispatch); the result is consumed
         by the next loop turn, overlapping transfer+compute with the
         host-side env step and bookkeeping.
         """
         policy = self.policy
-        frame = self._host_obs
         done = self._host_done
-        frame_d = jax.device_put(frame, policy._bsharded)
         done_d = jax.device_put(done, policy._bsharded)
-        self.bytes_h2d += frame.nbytes + done.nbytes
-        with policy._update_lock:
-            self._pending = self._step_fn(
-                policy.params, self._stack, frame_d, done_d,
-                policy._next_rng(), self.explore)
+        self.bytes_h2d += done.nbytes
+        if self.delta:
+            ds = self._host_delta
+            if ds is not None and len(ds.full_rows):
+                # Resets / over-budget rows: bucketed full-row scatter
+                # ahead of the sparse delta (delta entries for these
+                # rows are pad, per the DeltaStep contract).
+                b = 1 << (int(len(ds.full_rows)) - 1).bit_length() \
+                    if len(ds.full_rows) > 1 else 1
+                b = min(b, self._n)
+                rows = np.full(b, self._n, np.int32)
+                rows[:len(ds.full_rows)] = ds.full_rows
+                fulls = np.zeros((b, self._hw), np.uint8)
+                fulls[:len(ds.full_rows)] = ds.full_frames
+                self._frames_d = self._full_fn(b)(
+                    self._frames_d,
+                    jax.device_put(rows, policy._repl),
+                    jax.device_put(fulls, policy._repl))
+                self.bytes_h2d += rows.nbytes + fulls.nbytes
+            if ds is None:
+                # First step after reset: frames already uploaded whole;
+                # an all-pad delta leaves them untouched.
+                from ..env.delta_obs import all_pad_delta
+                pad = all_pad_delta(
+                    self._n, int(self.env.delta_budget), self._hw)
+                idx, val = pad.idx, pad.val
+            else:
+                idx, val = ds.idx, ds.val
+            idx_d = jax.device_put(idx, policy._bsharded)
+            val_d = jax.device_put(val, policy._bsharded)
+            self.bytes_h2d += idx.nbytes + val.nbytes
+            with policy._update_lock:
+                self._pending = self._step_fn(
+                    policy.params, self._stack, self._frames_d, idx_d,
+                    val_d, done_d, policy._next_rng(), self.explore)
+            self._frames_d = self._pending[5]
+        else:
+            frame = self._host_obs
+            frame_d = jax.device_put(frame, policy._bsharded)
+            self.bytes_h2d += frame.nbytes
+            with policy._update_lock:
+                self._pending = self._step_fn(
+                    policy.params, self._stack, frame_d, done_d,
+                    policy._next_rng(), self.explore)
         if self.frame_stack:
             self._stack = self._pending[4]
 
@@ -162,7 +247,8 @@ class DeviceSebulbaSampler:
         for t in range(T):
             if self._pending is None:
                 self._dispatch_step()
-            acts_d, logp_d, di_d, val_d, obs_d = self._pending
+            pend = self._pending
+            acts_d, logp_d, di_d, val_d, obs_d = pend[:5]
             self._pending = None
             obs_buf.append(obs_d)
             logp_buf.append(logp_d)
@@ -173,7 +259,12 @@ class DeviceSebulbaSampler:
             self.t_fetch += time.perf_counter() - t0
             self.bytes_d2h += actions.nbytes
             t0 = time.perf_counter()
-            next_obs, rewards, dones = self.env.vector_step(actions)
+            if self.delta:
+                self._host_delta, rewards, dones = \
+                    self.env.vector_step_delta(actions)
+            else:
+                next_obs, rewards, dones = self.env.vector_step(actions)
+                self._host_obs = np.asarray(next_obs)
             self.t_env += time.perf_counter() - t0
             eps_ids[t] = self._cur_eps
             ts[t] = self._ep_len
@@ -192,7 +283,6 @@ class DeviceSebulbaSampler:
                 self._cur_eps[dones] = self._eps_counter + np.arange(
                     len(done_idx), dtype=np.int64)
                 self._eps_counter += len(done_idx)
-            self._host_obs = np.asarray(next_obs)
             self._host_done = np.asarray(dones)
             # Prefetch: inference for the NEXT obs runs while this turn
             # finishes bookkeeping (and while the learner trains).

@@ -156,7 +156,9 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                  inline_env_config=None,
                  inline_seed=None,
                  device_rollouts: str = "auto",
-                 device_frame_stack: int = 0):
+                 device_frame_stack: int = 0,
+                 obs_delta="auto",
+                 obs_delta_budget: int = 256):
         super().__init__(workers)
         self.train_batch_size = train_batch_size
         self.rollout_fragment_length = rollout_fragment_length
@@ -209,11 +211,14 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                     inline_env, inline_num_envs, inline_env_config,
                     seed=None if inline_seed is None
                     else inline_seed + 1000 * (k + 1),
-                    device_frame_stack=device_frame_stack)
+                    device_frame_stack=device_frame_stack,
+                    obs_delta=obs_delta if use_device else False,
+                    obs_delta_budget=obs_delta_budget)
                 if use_device:
                     sampler = DeviceSebulbaSampler(
                         benv, policy, rollout_fragment_length,
-                        eps_id_offset=(k + 1) << 40)
+                        eps_id_offset=(k + 1) << 40,
+                        use_delta=obs_delta is not False)
                 else:
                     sampler = VectorSampler(
                         benv, policy, rollout_fragment_length,
