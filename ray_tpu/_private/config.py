@@ -90,9 +90,20 @@ _def("RAY_TPU_NATIVE_CACHE", str, None,
      "Directory for compiled native components "
      "(default ~/.cache/ray_tpu_native)")
 
+# --- memory monitor ---------------------------------------------------
+_def("RAY_TPU_MEMORY_USAGE_THRESHOLD", float, 0.95,
+     "Node memory fraction above which new tasks fail with "
+     "RayOutOfMemoryError and the head stops placing work on the node "
+     "(<=0 disables; reference memory_monitor.py:64)")
+_def("RAY_TPU_MEMORY_MONITOR_INTERVAL_S", float, 0.25,
+     "Min seconds between real memory checks on the worker hot path")
+
 # --- streaming --------------------------------------------------------
 _def("RAY_TPU_STREAMING_CREDITS", int, 32,
      "Max unprocessed items in flight per streaming operator edge")
+_def("RAY_TPU_STREAMING_OPERATOR_RESTARTS", int, 2,
+     "max_restarts for streaming operator actors; senders replay their "
+     "credit window into the restarted instance (at-least-once)")
 
 
 def get(name: str):

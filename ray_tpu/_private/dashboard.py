@@ -73,6 +73,9 @@ def render(head) -> str:
         f'{"ALIVE" if n["alive"] else "DEAD"}</span>',
         _fmt_res(n["total_resources"]),
         _fmt_res(n["available_resources"]),
+        (f'<span class="dead">{100 * n.get("mem_frac", 0):.0f}% '
+         "LOW</span>" if n.get("low_memory")
+         else f'{100 * n.get("mem_frac", 0):.0f}%'),
     ) for n in nodes]
     actor_rows = [(
         n["actor_id"].hex()[:12] if hasattr(n["actor_id"], "hex")
@@ -90,7 +93,7 @@ def render(head) -> str:
         n_nodes=len(nodes), n_actors=len(actors),
         inflight=inflight, pending=pending,
         nodes=_table(
-            ("node", "state", "total", "available"), node_rows),
+            ("node", "state", "total", "available", "mem"), node_rows),
         actors=_table(
             ("actor", "name", "state", "restarts left", "death reason"),
             actor_rows),

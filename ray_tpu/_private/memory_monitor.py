@@ -1,0 +1,142 @@
+"""Low-memory protection: raise a typed error before the OOM killer.
+
+Parity: `python/ray/memory_monitor.py:64` — the reference checks
+psutil-reported usage before each task and raises `RayOutOfMemoryError`
+with a per-process table when the node is nearly full, because the
+kernel OOM killer's alternative is a SIGKILLed worker (or raylet) and a
+much harder debugging story.
+
+Here: cgroup-aware (v2 `memory.max`/`memory.current`, v1
+`memory/memory.limit_in_bytes`, `/proc/meminfo` fallback), no psutil
+dependency. Two consumers:
+
+- every worker calls `raise_if_low_memory()` (throttled) before
+  executing a task (`runtime._execute_one`) — the task fails with
+  `RayOutOfMemoryError` as the cause instead of the node dying;
+- node agents ship `mem_frac` in their heartbeats; the head stops
+  granting leases / placing new work on nodes above the threshold
+  (`NodeInfo.fits`) and the dashboard shows per-node memory.
+
+Tunables: `RAY_TPU_MEMORY_USAGE_THRESHOLD` (fraction, <=0 disables),
+`RAY_TPU_MEMORY_MONITOR_INTERVAL_S` (min seconds between real checks).
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Tuple
+
+from ..exceptions import RayOutOfMemoryError
+
+_CGROUP_V2_MAX = "/sys/fs/cgroup/memory.max"
+_CGROUP_V2_CUR = "/sys/fs/cgroup/memory.current"
+_CGROUP_V1_MAX = "/sys/fs/cgroup/memory/memory.limit_in_bytes"
+_CGROUP_V1_CUR = "/sys/fs/cgroup/memory/memory.usage_in_bytes"
+
+
+def _read_int(path: str) -> Optional[int]:
+    try:
+        with open(path) as f:
+            raw = f.read().strip()
+        if raw == "max":
+            return None
+        return int(raw)
+    except (OSError, ValueError):
+        return None
+
+
+def _meminfo() -> Tuple[int, int]:
+    """(total_bytes, available_bytes) from /proc/meminfo."""
+    total = avail = 0
+    with open("/proc/meminfo") as f:
+        for line in f:
+            if line.startswith("MemTotal:"):
+                total = int(line.split()[1]) * 1024
+            elif line.startswith("MemAvailable:"):
+                avail = int(line.split()[1]) * 1024
+            if total and avail:
+                break
+    return total, avail
+
+
+def get_memory_usage() -> Tuple[int, int]:
+    """(used_bytes, total_bytes) for this node — the tighter of the
+    cgroup limit (container) and the machine's physical memory."""
+    sys_total, sys_avail = _meminfo()
+    used = sys_total - sys_avail
+    total = sys_total
+    for max_p, cur_p in ((_CGROUP_V2_MAX, _CGROUP_V2_CUR),
+                         (_CGROUP_V1_MAX, _CGROUP_V1_CUR)):
+        limit = _read_int(max_p)
+        cur = _read_int(cur_p)
+        if limit is not None and cur is not None and limit < sys_total:
+            return cur, limit
+    return used, total
+
+
+def _top_processes(n: int = 8) -> str:
+    """Per-process RSS table for the error message (reference prints
+    the same shape via psutil)."""
+    rows = []
+    for pid in os.listdir("/proc"):
+        if not pid.isdigit():
+            continue
+        try:
+            with open(f"/proc/{pid}/statm") as f:
+                rss_pages = int(f.read().split()[1])
+            with open(f"/proc/{pid}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\0", b" ").decode(
+                    errors="replace").strip()[:80]
+            rows.append((rss_pages * os.sysconf("SC_PAGE_SIZE"),
+                         int(pid), cmd or "?"))
+        except (OSError, ValueError, IndexError):
+            continue
+    rows.sort(reverse=True)
+    lines = [f"  {rss / 1e9:6.2f} GB  pid={pid:<7d} {cmd}"
+             for rss, pid, cmd in rows[:n]]
+    return "\n".join(lines)
+
+
+class MemoryMonitor:
+    """Throttled low-memory guard (reference `memory_monitor.py:29`)."""
+
+    def __init__(self, error_threshold: Optional[float] = None,
+                 check_interval_s: Optional[float] = None):
+        from . import config
+        self.error_threshold = (
+            error_threshold if error_threshold is not None
+            else config.get("RAY_TPU_MEMORY_USAGE_THRESHOLD"))
+        self.check_interval_s = (
+            check_interval_s if check_interval_s is not None
+            else config.get("RAY_TPU_MEMORY_MONITOR_INTERVAL_S"))
+        self._last_check = 0.0
+        self.disabled = (self.error_threshold is None
+                         or self.error_threshold <= 0
+                         or not os.path.exists("/proc/meminfo"))
+
+    def mem_frac(self) -> float:
+        used, total = get_memory_usage()
+        return used / total if total else 0.0
+
+    def raise_if_low_memory(self, context: str = "") -> None:
+        """Raise RayOutOfMemoryError when node memory use exceeds the
+        threshold. Real checks are throttled to one per
+        `check_interval_s`; in between it returns immediately."""
+        if self.disabled:
+            return
+        now = time.monotonic()
+        if now - self._last_check < self.check_interval_s:
+            return
+        self._last_check = now
+        used, total = get_memory_usage()
+        if total and used / total > self.error_threshold:
+            raise RayOutOfMemoryError(
+                f"node memory low: {used / 1e9:.2f}/{total / 1e9:.2f} GB "
+                f"({100 * used / total:.0f}%) used exceeds the "
+                f"{100 * self.error_threshold:.0f}% threshold"
+                + (f" (while starting {context})" if context else "")
+                + ". Top memory consumers:\n" + _top_processes()
+                + "\nRefusing to start new work so the OOM killer "
+                  "doesn't take the node down; reduce per-task memory, "
+                  "add nodes, or raise RAY_TPU_MEMORY_USAGE_THRESHOLD.")

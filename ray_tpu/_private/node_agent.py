@@ -126,7 +126,9 @@ class NodeAgent:
         # beating (reference: raylet_heartbeat_timeout_milliseconds,
         # `ray_config_def.h:24`).
         from . import config
+        from .memory_monitor import MemoryMonitor
         hb_interval = config.get("RAY_TPU_HEARTBEAT_INTERVAL_S")
+        mem_monitor = MemoryMonitor()
         last_hb = 0.0
         while not self._shutdown.is_set():
             time.sleep(0.05)
@@ -134,8 +136,13 @@ class NodeAgent:
             if now - last_hb >= hb_interval:
                 last_hb = now
                 try:
-                    self.head.send({"kind": "heartbeat",
-                                    "node_id": self.node_id})
+                    # mem_frac lets the head gate placement on this
+                    # node before its OOM killer fires (NodeInfo.fits).
+                    self.head.send({
+                        "kind": "heartbeat",
+                        "node_id": self.node_id,
+                        "mem_frac": 0.0 if mem_monitor.disabled
+                        else round(mem_monitor.mem_frac(), 4)})
                 except protocol.ConnectionClosed:
                     return
             dead = []

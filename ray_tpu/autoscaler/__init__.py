@@ -1,3 +1,4 @@
-from .autoscaler import StandardAutoscaler  # noqa: F401
+from .autoscaler import StandardAutoscaler, validate_cluster_config  # noqa: F401
 from .load_metrics import LoadMetrics  # noqa: F401
-from .node_provider import LocalNodeProvider, NodeProvider  # noqa: F401
+from .node_provider import (CommandNodeProvider, LocalNodeProvider,  # noqa: F401
+                            NodeProvider)

@@ -4,11 +4,21 @@ Parity: `python/ray/autoscaler/autoscaler.py:376` (StandardAutoscaler,
 driven by `monitor.py`). Policy:
 
 - bringup: launch toward `min_workers` immediately;
-- scale UP when the head reports unplaceable demand (pending task
-  queue + unserved lease requests), in bounded launch batches, never
-  past `max_workers`;
+- scale UP toward the SHAPE of the unplaceable demand: the head's
+  snapshot carries the pending work's resource vectors
+  (`head.cluster_load` pending_demand), each vector is matched to the
+  first configured worker type that fits it, and that type is launched
+  — a `{"GPUX": 1}` backlog launches GPUX nodes, a CPU backlog does
+  not (reference LoadMetrics tracks resource vectors for the same
+  reason, autoscaler.py:155,376). Demand no type can fit is logged,
+  never serviced by blind launches. Launches are bounded per tick by
+  `max_launch_batch` and per type / globally by `max_workers`;
 - scale DOWN workers whose resources have been fully idle for
   `idle_timeout_s`, never below `min_workers`.
+
+Cluster yamls are validated against an explicit schema
+(`validate_cluster_config`): unknown keys are an error listing the
+valid ones (reference `autoscaler.py:815` jsonschema validation).
 
 `update()` is pull-driven: `AutoscalerMonitor` (monitor.py) polls the
 head's node table into LoadMetrics and calls it periodically — the same
@@ -19,7 +29,7 @@ node_provider.py).
 from __future__ import annotations
 
 import logging
-from typing import Optional
+from typing import Dict, List, Optional
 
 from .load_metrics import LoadMetrics
 from .node_provider import NodeProvider
@@ -31,7 +41,61 @@ DEFAULT_CONFIG = {
     "max_workers": 4,
     "idle_timeout_s": 60.0,
     "max_launch_batch": 2,
+    # name -> {"resources": {...}, "max_workers": int} — when empty the
+    # provider's single default type serves all demand (legacy shape).
+    "worker_types": {},
 }
+
+# Yaml schema for `ray_tpu up` cluster configs: key -> (type, doc).
+CLUSTER_CONFIG_SCHEMA = {
+    "cluster_name": (str, "name prefix for launched nodes"),
+    "head_resources": (dict, "resource vector for the head node"),
+    "worker_resources": (dict, "default worker resource vector"),
+    "worker_types": (dict, "name -> {resources, max_workers}: "
+                           "heterogeneous worker pools"),
+    "min_workers": (int, "nodes kept alive regardless of load"),
+    "max_workers": (int, "global node cap"),
+    "idle_timeout_s": ((int, float), "idle seconds before retiring"),
+    "max_launch_batch": (int, "max launches per autoscaler tick"),
+    "update_interval_s": ((int, float), "autoscaler poll period"),
+    "ssh": (dict, "remote provider: hosts/command templates "
+                  "(see node_provider.CommandNodeProvider)"),
+}
+
+
+def validate_cluster_config(cfg: dict) -> dict:
+    """Validate a `ray_tpu up` yaml dict; raises ValueError naming the
+    offending key and listing valid ones (ref autoscaler.py:815)."""
+    cfg = dict(cfg or {})
+    for key, value in cfg.items():
+        if key not in CLUSTER_CONFIG_SCHEMA:
+            raise ValueError(
+                f"unknown cluster config key {key!r}; valid keys: "
+                f"{sorted(CLUSTER_CONFIG_SCHEMA)}")
+        want, _doc = CLUSTER_CONFIG_SCHEMA[key]
+        if not isinstance(value, want):
+            raise ValueError(
+                f"cluster config key {key!r} must be "
+                f"{getattr(want, '__name__', want)}, got "
+                f"{type(value).__name__}")
+    for name, spec in (cfg.get("worker_types") or {}).items():
+        if not isinstance(spec, dict) or "resources" not in spec:
+            raise ValueError(
+                f"worker_types[{name!r}] must be a dict with a "
+                "'resources' vector (optional 'max_workers')")
+        unknown = set(spec) - {"resources", "max_workers", "min_workers"}
+        if unknown:
+            raise ValueError(
+                f"worker_types[{name!r}] has unknown keys "
+                f"{sorted(unknown)}; valid: resources, max_workers, "
+                "min_workers")
+    return cfg
+
+
+def _fits(node_resources: Dict[str, float],
+          demand: Dict[str, float]) -> bool:
+    return all(float(node_resources.get(k, 0.0)) >= float(v)
+               for k, v in (demand or {}).items() if float(v) > 0)
 
 
 class StandardAutoscaler:
@@ -46,6 +110,23 @@ class StandardAutoscaler:
         self.num_terminations = 0
 
     # ------------------------------------------------------------------
+    def _nodes_by_type(self, nodes: List[str]) -> Dict[Optional[str], int]:
+        get_type = getattr(self.provider, "node_type", lambda nid: None)
+        counts: Dict[Optional[str], int] = {}
+        for nid in nodes:
+            counts[get_type(nid)] = counts.get(get_type(nid), 0) + 1
+        return counts
+
+    def _launch(self, count: int, node_type: Optional[str]) -> None:
+        if node_type is None:
+            created = self.provider.create_node(count)
+        else:
+            created = self.provider.create_node(count,
+                                                node_type=node_type)
+        for nid in created:
+            self.load_metrics.mark_active(nid)
+        self.num_launches += len(created)
+
     def update(self) -> None:
         nodes = self.provider.non_terminated_nodes()
         self.load_metrics.prune_inactive(set(nodes))
@@ -74,17 +155,96 @@ class StandardAutoscaler:
 
         # -- scale up --------------------------------------------------
         max_w = int(self.config["max_workers"])
-        target = min_w
-        if self.load_metrics.queued_demand > 0:
-            # Unplaceable work: grow by one launch batch toward max.
-            target = min(max_w, len(nodes)
-                         + int(self.config["max_launch_batch"]))
-        if len(nodes) < target:
-            need = target - len(nodes)
-            logger.info("autoscaler: launching %d node(s) "
-                        "(have %d, queued_demand %d)",
-                        need, len(nodes),
-                        self.load_metrics.queued_demand)
-            for nid in self.provider.create_node(need):
-                self.load_metrics.mark_active(nid)
-            self.num_launches += need
+        batch = int(self.config["max_launch_batch"])
+        worker_types: Dict[str, dict] = self.config.get(
+            "worker_types") or {}
+
+        # Bringup toward min_workers (not batch-limited — bringup is
+        # config-driven, not demand-driven): global floor on the
+        # default type, plus each worker type's own min_workers floor.
+        if len(nodes) < min_w:
+            need = min_w - len(nodes)
+            logger.info("autoscaler: bringup %d node(s) toward "
+                        "min_workers=%d", need, min_w)
+            self._launch(need, None)
+            nodes = self.provider.non_terminated_nodes()
+        type_counts = self._nodes_by_type(nodes)
+        for tname, spec in (self.config.get("worker_types")
+                            or {}).items():
+            t_min = int(spec.get("min_workers", 0))
+            have = type_counts.get(tname, 0)
+            if have < t_min:
+                logger.info("autoscaler: bringup %d %s node(s) toward "
+                            "its min_workers=%d", t_min - have, tname,
+                            t_min)
+                self._launch(t_min - have, tname)
+                nodes = self.provider.non_terminated_nodes()
+
+        demand_vectors = self.load_metrics.pending_demand
+        if demand_vectors is None:
+            # Legacy scalar demand: homogeneous growth (no shape info).
+            if self.load_metrics.queued_demand > 0 and len(nodes) < max_w:
+                need = min(batch, max_w - len(nodes))
+                logger.info(
+                    "autoscaler: launching %d node(s) "
+                    "(have %d, queued_demand %d)",
+                    need, len(nodes), self.load_metrics.queued_demand)
+                self._launch(need, None)
+            return
+        if not demand_vectors:
+            return
+
+        # Demand-shape matching: pick the first type that fits each
+        # pending vector; launch per-type up to caps.
+        counts = self._nodes_by_type(nodes)
+        total = len(nodes)
+        want: Dict[Optional[str], int] = {}
+        unmatched = 0
+        for demand in demand_vectors:
+            chosen = None
+            if worker_types:
+                for name, spec in worker_types.items():
+                    if _fits(spec.get("resources") or {}, demand):
+                        chosen = name
+                        break
+            else:
+                default_res = getattr(
+                    self.provider, "default_node_resources", None)
+                if default_res is None or _fits(default_res, demand):
+                    chosen = None  # default type serves it
+                else:
+                    unmatched += 1
+                    continue
+            if chosen is None and worker_types:
+                unmatched += 1
+                continue
+            want[chosen] = want.get(chosen, 0) + 1
+        if unmatched:
+            logger.warning(
+                "autoscaler: %d pending demand vector(s) fit no "
+                "configured worker type (types: %s) — not launching "
+                "for them", unmatched,
+                sorted(worker_types) or "[default]")
+        # max_launch_batch is a PER-TICK budget across all types, and a
+        # type never gets more nodes than it has demand vectors.
+        budget = batch
+        for node_type, n_want in sorted(
+                want.items(), key=lambda kv: -kv[1]):
+            if total >= max_w or budget <= 0:
+                break
+            type_cap = max_w
+            if node_type is not None:
+                type_cap = int(worker_types[node_type].get(
+                    "max_workers", max_w))
+            have = counts.get(node_type, 0)
+            need = min(budget, n_want, max_w - total, type_cap - have)
+            if need <= 0:
+                continue
+            logger.info(
+                "autoscaler: launching %d %s node(s) toward %d "
+                "pending demand vector(s)", need,
+                node_type or "default", n_want)
+            self._launch(need, node_type)
+            total += need
+            budget -= need
+            counts[node_type] = have + need

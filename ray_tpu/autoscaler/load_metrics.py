@@ -22,6 +22,10 @@ class LoadMetrics:
         # Demand the scheduler could not place anywhere (pending task
         # queue + unserved lease requests).
         self.queued_demand = 0
+        # Resource VECTORS of that demand (capped sample from the head;
+        # see head.cluster_load). None = shape unknown (legacy feeders),
+        # [] = no demand, [{...}, ...] = per-item vectors.
+        self.pending_demand = None
 
     def update(self, node_id: str, static: dict, dynamic: dict) -> None:
         now = time.time()

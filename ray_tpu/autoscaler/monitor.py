@@ -54,6 +54,8 @@ class AutoscalerMonitor:
                     node["available_resources"])
         self.load_metrics.queued_demand = (
             snap["pending_tasks"] + snap["lease_queue_depth"])
+        if "pending_demand" in snap:
+            self.load_metrics.pending_demand = snap["pending_demand"]
         self.autoscaler.update()
 
     def _run(self):

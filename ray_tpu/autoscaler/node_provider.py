@@ -2,20 +2,34 @@
 
 Parity: `python/ray/autoscaler/node_provider.py` — the provider
 abstraction behind the reference's AWS/GCP/local launchers. The cloud
-SDK breadth is out of scope; the LOCAL provider is fully functional:
-it launches per-node agents (`_private/node_agent.py`) as subprocesses
-against a running head, the same join path `cluster_utils.Cluster`
-uses, so autoscaled "nodes" run the real multi-node machinery (own
-node id, resource vector, node-scoped shm store, chunked transfer).
+SDK breadth is out of scope; two providers are fully functional:
+
+- `LocalNodeProvider`: per-node agents (`_private/node_agent.py`) as
+  subprocesses against a running head — the same join path
+  `cluster_utils.Cluster` uses, so autoscaled "nodes" run the real
+  multi-node machinery (own node id, resource vector, node-scoped shm
+  store, chunked transfer). Supports heterogeneous `worker_types`
+  (name -> resource vector) for demand-shape-aware scaling.
+- `CommandNodeProvider`: reaches REAL remote hosts through command
+  templates (ssh by default, any transport by config) — the
+  equivalent of the reference's SSH updater plane
+  (`python/ray/autoscaler/updater.py`): the autoscaler launches a
+  node by running the configured start command on the next free host.
+  Tested against local `bash -c` templates; the ssh shape is
+  documented in the class docstring.
 """
 
 from __future__ import annotations
 
 import json
+import logging
 import os
+import shlex
 import subprocess
 import sys
 from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 
 class NodeProvider:
@@ -27,11 +41,16 @@ class NodeProvider:
     def is_running(self, node_id: str) -> bool:
         raise NotImplementedError
 
-    def create_node(self, count: int = 1) -> List[str]:
+    def create_node(self, count: int = 1,
+                    node_type: Optional[str] = None) -> List[str]:
         raise NotImplementedError
 
     def terminate_node(self, node_id: str) -> None:
         raise NotImplementedError
+
+    def node_type(self, node_id: str) -> Optional[str]:
+        """Worker-type name the node was launched as (None = default)."""
+        return None
 
     def shutdown(self) -> None:
         for nid in self.non_terminated_nodes():
@@ -44,14 +63,25 @@ class LocalNodeProvider(NodeProvider):
     def __init__(self, head_addr: str, session_dir: str,
                  session_name: str,
                  node_resources: Optional[Dict[str, float]] = None,
+                 worker_types: Optional[Dict[str, dict]] = None,
                  name_prefix: str = "autoscaled"):
         self.head_addr = head_addr
         self.session_dir = session_dir
         self.session_name = session_name
         self.node_resources = dict(node_resources or {"CPU": 1.0})
+        # name -> {"resources": {...}} (extra keys ignored here; caps
+        # live in the autoscaler config).
+        self.worker_types = {
+            name: dict(spec.get("resources") or {})
+            for name, spec in (worker_types or {}).items()}
         self.name_prefix = name_prefix
         self._procs: Dict[str, subprocess.Popen] = {}
+        self._types: Dict[str, Optional[str]] = {}
         self._counter = 0
+
+    @property
+    def default_node_resources(self) -> Dict[str, float]:
+        return dict(self.node_resources)
 
     def non_terminated_nodes(self) -> List[str]:
         return [nid for nid, p in self._procs.items()
@@ -61,11 +91,23 @@ class LocalNodeProvider(NodeProvider):
         p = self._procs.get(node_id)
         return p is not None and p.poll() is None
 
-    def create_node(self, count: int = 1) -> List[str]:
+    def node_type(self, node_id: str) -> Optional[str]:
+        return self._types.get(node_id)
+
+    def create_node(self, count: int = 1,
+                    node_type: Optional[str] = None) -> List[str]:
+        if node_type is not None and node_type not in self.worker_types:
+            raise ValueError(
+                f"unknown worker type {node_type!r}; configured: "
+                f"{sorted(self.worker_types)}")
+        resources = (self.worker_types[node_type]
+                     if node_type is not None else self.node_resources)
         created = []
         for _ in range(count):
             self._counter += 1
-            node_id = f"{self.name_prefix}-{self._counter}"
+            node_id = f"{self.name_prefix}-" \
+                + (f"{node_type}-" if node_type else "") \
+                + str(self._counter)
             node_dir = os.path.join(self.session_dir, f"node-{node_id}")
             os.makedirs(node_dir, exist_ok=True)
             env = dict(os.environ)
@@ -76,15 +118,17 @@ class LocalNodeProvider(NodeProvider):
                 [sys.executable, "-m", "ray_tpu._private.node_agent",
                  "--head-addr", self.head_addr,
                  "--node-id", node_id,
-                 "--resources", json.dumps(self.node_resources),
+                 "--resources", json.dumps(resources),
                  "--session-dir", node_dir,
                  "--session-name", self.session_name],
                 env=env)
+            self._types[node_id] = node_type
             created.append(node_id)
         return created
 
     def terminate_node(self, node_id: str) -> None:
         p = self._procs.pop(node_id, None)
+        self._types.pop(node_id, None)
         if p is None:
             return
         p.terminate()
@@ -93,3 +137,137 @@ class LocalNodeProvider(NodeProvider):
         except subprocess.TimeoutExpired:
             p.kill()
             p.wait(timeout=5)
+
+
+class CommandNodeProvider(NodeProvider):
+    """Remote hosts driven by command templates (ssh by default).
+
+    Config (the `ssh:` block of a cluster yaml):
+
+        ssh:
+          hosts: ["10.0.0.4", "10.0.0.5"]          # worker pool
+          start_command: >-
+            ssh {host} 'ray_tpu start --address={head_addr}
+            --resources={resources_json!r}'
+          stop_command: "ssh {host} 'ray_tpu stop'"
+          setup_command: "scp -r ./myproject {host}:~/"   # optional
+
+    Placeholders: {host}, {head_addr}, {node_id}, {resources_json}.
+    One node per host; `create_node` claims the next free host, runs
+    `setup_command` (once per host) then `start_command`; `terminate`
+    runs `stop_command` and frees the host. Any transport works — the
+    tests drive it with local `bash -c` templates; ssh is the intended
+    production shape (reference analog: `autoscaler/updater.py`
+    NodeUpdater ssh plane + `commands.py`).
+
+    The start command is expected to RETURN once the remote node agent
+    is launched (use `ray_tpu start` daemonized on the remote end, or
+    `ssh -f`); a command that exits non-zero marks the launch failed
+    and frees the host.
+    """
+
+    def __init__(self, head_addr: str,
+                 hosts: List[str],
+                 start_command: str,
+                 stop_command: str = "",
+                 setup_command: str = "",
+                 node_resources: Optional[Dict[str, float]] = None,
+                 worker_types: Optional[Dict[str, dict]] = None):
+        self.head_addr = head_addr
+        self.hosts = list(hosts)
+        self.start_command = start_command
+        self.stop_command = stop_command
+        self.setup_command = setup_command
+        self.node_resources = dict(node_resources or {"CPU": 1.0})
+        self.worker_types = {
+            name: dict(spec.get("resources") or {})
+            for name, spec in (worker_types or {}).items()}
+        self._nodes: Dict[str, str] = {}  # node_id -> host
+        self._types: Dict[str, Optional[str]] = {}
+        self._setup_done: set = set()
+        self._counter = 0
+
+    @property
+    def default_node_resources(self) -> Dict[str, float]:
+        return dict(self.node_resources)
+
+    def _free_hosts(self) -> List[str]:
+        used = set(self._nodes.values())
+        return [h for h in self.hosts if h not in used]
+
+    def _run(self, template: str, host: str, node_id: str,
+             resources: Dict[str, float]) -> bool:
+        cmd = template.format(
+            host=host, head_addr=self.head_addr, node_id=node_id,
+            resources_json=json.dumps(resources))
+        try:
+            subprocess.run(
+                cmd if any(c in cmd for c in "|&;<>$'\"")
+                else shlex.split(cmd),
+                shell=any(c in cmd for c in "|&;<>$'\""),
+                check=True, timeout=120)
+            return True
+        except Exception as e:
+            logger.warning("provider command failed on %s: %r", host, e)
+            return False
+
+    def non_terminated_nodes(self) -> List[str]:
+        return list(self._nodes)
+
+    def is_running(self, node_id: str) -> bool:
+        return node_id in self._nodes
+
+    def node_type(self, node_id: str) -> Optional[str]:
+        return self._types.get(node_id)
+
+    def create_node(self, count: int = 1,
+                    node_type: Optional[str] = None) -> List[str]:
+        if node_type is not None and node_type not in self.worker_types:
+            raise ValueError(
+                f"unknown worker type {node_type!r}; configured: "
+                f"{sorted(self.worker_types)}")
+        resources = (self.worker_types[node_type]
+                     if node_type is not None else self.node_resources)
+        created = []
+        failed_hosts: set = set()  # don't re-pick a host that just
+        # failed within this call (it would starve the healthy ones)
+        while len(created) < count:
+            free = [h for h in self._free_hosts()
+                    if h not in failed_hosts]
+            if not free:
+                logger.warning(
+                    "CommandNodeProvider: no usable free hosts "
+                    "(%d configured, %d claimed, %d failed this call)",
+                    len(self.hosts),
+                    len(set(self._nodes.values())), len(failed_hosts))
+                break
+            host = free[0]
+            self._counter += 1
+            node_id = f"cmd-{self._counter}"
+            if self.setup_command and host not in self._setup_done:
+                if not self._run(self.setup_command, host, node_id,
+                                 resources):
+                    failed_hosts.add(host)
+                    continue
+                self._setup_done.add(host)
+            # Claim before launching so concurrent ticks don't double-
+            # assign the host; unclaim on failure.
+            self._nodes[node_id] = host
+            self._types[node_id] = node_type
+            if not self._run(self.start_command, host, node_id,
+                             resources):
+                del self._nodes[node_id]
+                del self._types[node_id]
+                failed_hosts.add(host)
+                continue
+            created.append(node_id)
+        return created
+
+    def terminate_node(self, node_id: str) -> None:
+        host = self._nodes.pop(node_id, None)
+        node_type = self._types.pop(node_id, None)
+        if host is None or not self.stop_command:
+            return
+        resources = (self.worker_types.get(node_type)
+                     or self.node_resources)
+        self._run(self.stop_command, host, node_id, resources)

@@ -73,6 +73,12 @@ class ObjectLostError(RayError):
     """The object's value was lost (owner died or store evicted it)."""
 
 
+class RayOutOfMemoryError(RayError):
+    """Node memory use crossed the low-memory threshold; new work is
+    refused before the kernel OOM killer fires (parity:
+    `python/ray/memory_monitor.py:64`)."""
+
+
 class ObjectStoreFullError(RayError):
     """The shared object store is at capacity and nothing is evictable
     (parity: plasma's ObjectStoreFullError)."""

@@ -96,6 +96,11 @@ def _maddpg():
     return MADDPGTrainer
 
 
+def _alpha_zero():
+    from ..contrib.alpha_zero import AlphaZeroTrainer
+    return AlphaZeroTrainer
+
+
 ALGORITHMS = {
     "PG": _pg,
     "PPO": _ppo,
@@ -118,6 +123,8 @@ ALGORITHMS = {
     # Contributed algorithms (parity: rllib/contrib registry entries).
     "contrib/MADDPG": _maddpg,
     "MADDPG": _maddpg,
+    "contrib/AlphaZero": _alpha_zero,
+    "AlphaZero": _alpha_zero,
 }
 
 

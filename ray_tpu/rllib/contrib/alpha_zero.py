@@ -1,0 +1,485 @@
+"""AlphaZero (contrib): MCTS self-play + ranked-reward policy learning.
+
+Parity: `rllib/contrib/alpha_zero/` — the reference packages an MCTS
+(`core/mcts.py`), a ranked-rewards transform for single-player scores
+(`core/ranked_rewards.py`), a policy whose loss matches search visit
+distributions + game outcomes (`core/alpha_zero_policy.py`), and a
+trainer running self-play workers against a replay buffer
+(`core/alpha_zero_trainer.py`), demoed on stateful CartPole.
+
+This is a re-derivation for the JAX stack, not a translation:
+
+- ONE jitted network evaluation serves every active env's current
+  search leaf per simulation step (lockstep-vectorized self-play) —
+  leaf evals are the MCTS hot loop, so they're batched onto the
+  device the way this framework batches everything else; the tree
+  walk itself is cheap host python over cloneable env states.
+- The policy is a plain `JaxPolicy` with an AlphaZero loss:
+  cross-entropy(model logits, MCTS visit distribution) + c_v *
+  MSE(value head, ranked-reward z). Search targets ride the standard
+  batch columns (ACTION_DIST_INPUTS carries the visit distribution,
+  VALUE_TARGETS carries z), so the device path needs nothing new.
+- Single-player scores become +-1 via Ranked Rewards (R2): z = +1 iff
+  the episode score reaches the `r2_percentile` of recent scores —
+  the self-play curriculum for single-agent domains.
+
+Envs must be STATE-CLONEABLE: expose `get_state() -> token` and
+`set_state(token) -> obs` (the search repeatedly rewinds). CartPole's
+adapter lives here (`StatefulCartPole`); any env with the same two
+methods works.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ...tune.trainable import Trainable
+from ..agents.trainer import COMMON_CONFIG
+from ..env.registry import make_env, register_env
+from ..utils.config import deep_merge
+
+DEFAULT_CONFIG = deep_merge(deep_merge({}, COMMON_CONFIG), {
+    "num_envs_per_worker": 8,     # lockstep self-play envs
+    "episodes_per_iter": 8,
+    "mcts_num_simulations": 30,
+    "puct_c": 1.25,
+    "dirichlet_alpha": 0.3,
+    "dirichlet_epsilon": 0.25,
+    "temperature": 1.0,
+    # Move index after which action selection becomes greedy argmax
+    # over visit counts (exploration only early in the episode).
+    "greedy_after_moves": 15,
+    "r2_percentile": 75.0,
+    "r2_buffer_size": 200,
+    # Value assigned to TERMINAL leaves reached inside the search:
+    # "r2" (reference behavior) scores them with the ranked-reward
+    # transform — right for score-maximizing games where episodes
+    # always terminate. "failure" scores every in-search terminal -1 —
+    # right for SURVIVAL tasks (CartPole): under "r2" a death just
+    # past the R2 threshold looks as good as surviving, so the search
+    # happily terminates and the self-play ratchet crawls. Training
+    # targets (z) always use the R2 transform either way.
+    "mcts_terminal_value": "r2",
+    "replay_buffer_size": 20_000,
+    "train_batch_size": 512,
+    "sgd_minibatch_size": 128,
+    "num_sgd_iter": 4,
+    "value_loss_coeff": 1.0,
+    "lr": 1e-3,
+    "model": {"fcnet_hiddens": [64, 64]},
+})
+
+
+# ---------------------------------------------------------------------
+# Stateful envs
+# ---------------------------------------------------------------------
+class StatefulCartPole:
+    """CartPole with `get_state`/`set_state` for tree search (the
+    reference wraps CartPole the same way, `examples/custom_cartpole`)."""
+
+    def __init__(self, max_steps: int = 200):
+        from ..env.env import CartPole
+        self._env = CartPole(max_steps=max_steps)
+        self.observation_space = self._env.observation_space
+        self.action_space = self._env.action_space
+
+    def reset(self):
+        return self._env.reset()
+
+    def step(self, action):
+        return self._env.step(action)
+
+    def get_state(self):
+        return (self._env._state.copy(), self._env._t)
+
+    def set_state(self, token):
+        state, t = token
+        self._env._state = state.copy()
+        self._env._t = t
+        return self._env._state.astype(np.float32)
+
+    def seed(self, seed=None):
+        self._env.seed(seed)
+
+    def close(self):
+        pass
+
+
+register_env(
+    "StatefulCartPole-v0",
+    lambda cfg: StatefulCartPole(max_steps=cfg.get("max_steps", 200)))
+
+
+# ---------------------------------------------------------------------
+# Ranked rewards (R2)
+# ---------------------------------------------------------------------
+class RankedRewardsBuffer:
+    """z = +1 iff score BEATS the `percentile` of recent scores
+    (parity: `core/ranked_rewards.py`): the agent is rewarded for
+    beating its own recent performance, giving a -1/+1 signal at any
+    skill level. The comparison is STRICT with a coin-flip on ties
+    (the reference resolves ties randomly too): with >=, a search can
+    park at "terminate exactly at the threshold" and the self-play
+    ratchet stalls — strict > forces each generation to exceed the
+    last one's 75th percentile."""
+
+    def __init__(self, size: int, percentile: float,
+                 rng: Optional[np.random.Generator] = None):
+        self.scores: deque = deque(maxlen=size)
+        self.percentile = percentile
+        self.rng = rng or np.random.default_rng(0)
+
+    def add(self, score: float) -> None:
+        self.scores.append(float(score))
+
+    def transform(self, score: float) -> float:
+        if len(self.scores) < 2:
+            return 1.0
+        threshold = float(np.percentile(self.scores, self.percentile))
+        if score > threshold:
+            return 1.0
+        if score == threshold:
+            return 1.0 if self.rng.random() < 0.5 else -1.0
+        return -1.0
+
+
+# ---------------------------------------------------------------------
+# MCTS
+# ---------------------------------------------------------------------
+class _Node:
+    __slots__ = ("token", "obs", "score", "done", "P", "N", "W",
+                 "children")
+
+    def __init__(self, token, obs, score, done):
+        self.token = token
+        self.obs = obs
+        self.score = score   # cumulative episode reward at this node
+        self.done = done
+        self.P: Optional[np.ndarray] = None
+        self.N: Optional[np.ndarray] = None
+        self.W: Optional[np.ndarray] = None
+        self.children: Dict[int, "_Node"] = {}
+
+
+class MCTS:
+    """PUCT tree search over one cloneable env (single player, no sign
+    flip on backup). `search_path` walks to an unexpanded leaf;
+    `expand_and_backup` consumes the leaf's network evaluation —
+    callers batch those evaluations across many MCTS instances
+    (`_evaluate_leaves` in the trainer)."""
+
+    def __init__(self, env, num_actions: int, c_puct: float,
+                 r2: RankedRewardsBuffer, rng: np.random.Generator,
+                 dirichlet_alpha: float, dirichlet_epsilon: float,
+                 terminal_value: str = "r2"):
+        self.env = env
+        self.A = num_actions
+        self.c = c_puct
+        self.r2 = r2
+        self.rng = rng
+        self.alpha = dirichlet_alpha
+        self.eps = dirichlet_epsilon
+        self.terminal_value = terminal_value
+        self.root: Optional[_Node] = None
+
+    def reset_root(self, obs, score: float) -> None:
+        self.root = _Node(self.env.get_state(), np.asarray(obs),
+                          score, False)
+
+    def _select(self, node: _Node) -> int:
+        sqrt_total = np.sqrt(max(1.0, node.N.sum()))
+        q = np.where(node.N > 0, node.W / np.maximum(node.N, 1), 0.0)
+        u = self.c * node.P * sqrt_total / (1.0 + node.N)
+        return int(np.argmax(q + u))
+
+    def search_path(self):
+        """Walk root->leaf. Returns (path of (node, action), leaf).
+        The leaf is unexpanded (P is None) or terminal."""
+        node = self.root
+        path: List = []
+        while node.P is not None and not node.done:
+            a = self._select(node)
+            child = node.children.get(a)
+            if child is None:
+                self.env.set_state(node.token)
+                obs, rew, done, _ = self.env.step(a)
+                child = _Node(self.env.get_state(), np.asarray(obs),
+                              node.score + rew, done)
+                node.children[a] = child
+            path.append((node, a))
+            node = child
+        return path, node
+
+    def expand_and_backup(self, path, leaf: _Node,
+                          priors: Optional[np.ndarray],
+                          value: Optional[float]) -> None:
+        if leaf.done:
+            value = (-1.0 if self.terminal_value == "failure"
+                     else self.r2.transform(leaf.score))
+        else:
+            if leaf.P is None:
+                leaf.P = np.asarray(priors, np.float64)
+                leaf.N = np.zeros(self.A)
+                leaf.W = np.zeros(self.A)
+                if leaf is self.root and self.eps > 0:
+                    noise = self.rng.dirichlet([self.alpha] * self.A)
+                    leaf.P = (1 - self.eps) * leaf.P + self.eps * noise
+            value = float(value)
+        for node, a in path:
+            node.N[a] += 1
+            node.W[a] += value
+
+    def visit_distribution(self) -> np.ndarray:
+        n = self.root.N
+        return (n / n.sum()) if n.sum() > 0 else np.full(
+            self.A, 1.0 / self.A)
+
+    def advance_root(self, action: int, obs, score: float) -> None:
+        """Reuse the chosen child's subtree for the next move."""
+        child = self.root.children.get(int(action))
+        if child is None or child.P is None:
+            self.reset_root(obs, score)
+        else:
+            self.root = child
+            # Fresh Dirichlet noise applies at the new root next expand;
+            # existing priors stay (standard subtree reuse).
+
+
+def alpha_zero_loss(policy, params, batch, rng, loss_state):
+    """CE(model logits, MCTS visit dist) + c_v * MSE(value, z).
+
+    The search targets arrive on standard device columns (module doc):
+    ACTION_DIST_INPUTS = visit distribution, VALUE_TARGETS = z."""
+    import jax.numpy as jnp
+
+    from .. import sample_batch as sb
+    logits, value = policy.apply(params, batch[sb.OBS])
+    log_probs = logits - jnp.log(
+        jnp.sum(jnp.exp(logits - logits.max(-1, keepdims=True)),
+                axis=-1, keepdims=True)) - logits.max(-1, keepdims=True)
+    target_pi = batch[sb.ACTION_DIST_INPUTS]
+    policy_loss = -jnp.mean(jnp.sum(target_pi * log_probs, axis=-1))
+    z = batch[sb.VALUE_TARGETS]
+    value_loss = jnp.mean((value - z) ** 2)
+    c_v = loss_state["value_loss_coeff"]
+    total = policy_loss + c_v * value_loss
+    return total, {"total_loss": total, "policy_loss": policy_loss,
+                   "vf_loss": value_loss}
+
+
+class AlphaZeroTrainer(Trainable):
+    """Self-play MCTS trainer (single worker, lockstep-vectorized envs).
+
+    Per `train()`: run `episodes_per_iter` self-play episodes where
+    every move distribution comes from `mcts_num_simulations` PUCT
+    simulations (leaf evaluations batched across envs into one jitted
+    call), push (obs, visit_dist, z) rows into the replay buffer, then
+    run `num_sgd_iter` minibatch updates of the AlphaZero loss.
+    """
+
+    _name = "contrib/AlphaZero"
+    _default_config = DEFAULT_CONFIG
+
+    def _setup(self, config):
+        import jax
+
+        from ..policy.jax_policy import JaxPolicy
+        merged = deep_merge(deep_merge({}, DEFAULT_CONFIG), config)
+        self.config = merged
+        env_id = merged.get("env") or "StatefulCartPole-v0"
+        self._env_creator = (
+            env_id if callable(env_id)
+            else (lambda cfg, _n=env_id: make_env(_n, cfg)))
+        probe = self._env_creator(dict(merged.get("env_config") or {}))
+        for m in ("get_state", "set_state"):
+            if not callable(getattr(probe, m, None)):
+                raise ValueError(
+                    "AlphaZero needs a state-cloneable env exposing "
+                    f"get_state/set_state; {env_id!r} lacks {m}() "
+                    "(see StatefulCartPole for the adapter shape)")
+        self._num_actions = probe.action_space.n
+        cfg = dict(merged)
+        cfg["loss_state"] = {
+            "value_loss_coeff": merged["value_loss_coeff"]}
+        self.policy = JaxPolicy(
+            probe.observation_space, probe.action_space, cfg,
+            loss_fn=alpha_zero_loss)
+        probe.close()
+        self._eval_fn = jax.jit(
+            lambda p, obs: self.policy.apply(p, obs))
+        self._rng = np.random.default_rng(merged.get("seed") or 0)
+        self.r2 = RankedRewardsBuffer(
+            merged["r2_buffer_size"], merged["r2_percentile"],
+            rng=self._rng)
+        self._replay: deque = deque(
+            maxlen=merged["replay_buffer_size"])
+        self._episodes_total = 0
+        self._az_timesteps = 0
+        self._recent_rewards: deque = deque(maxlen=100)
+
+    # -- self-play -----------------------------------------------------
+    def _evaluate_leaves(self, leaves: List[_Node]):
+        """One jitted eval for every env's current leaf."""
+        obs = np.stack([leaf.obs for leaf in leaves])
+        logits, values = self._eval_fn(self.policy.params, obs)
+        logits = np.asarray(logits, np.float64)
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        priors = e / e.sum(-1, keepdims=True)
+        return priors, np.asarray(values, np.float64)
+
+    def _self_play(self, num_episodes: int):
+        cfg = self.config
+        n = min(int(cfg["num_envs_per_worker"]), num_episodes)
+        envs = [self._env_creator(dict(cfg.get("env_config") or {}))
+                for _ in range(n)]
+        for i, env in enumerate(envs):
+            if cfg.get("seed") is not None:
+                env.seed(int(cfg["seed"]) + 977 * (i + 1)
+                         + self._episodes_total)
+        searches = [MCTS(env, self._num_actions, cfg["puct_c"],
+                         self.r2, self._rng, cfg["dirichlet_alpha"],
+                         cfg["dirichlet_epsilon"],
+                         terminal_value=cfg["mcts_terminal_value"])
+                    for env in envs]
+        obs = [env.reset() for env in envs]
+        for s, o in zip(searches, obs):
+            s.reset_root(o, 0.0)
+        episode_rows: List[List] = [[] for _ in envs]
+        moves = [0] * n
+        scores = [0.0] * n
+        completed = 0
+        active = set(range(n))
+        while active:
+            # One move for every active env: S simulations, each with
+            # ONE batched leaf evaluation across envs.
+            for _ in range(int(cfg["mcts_num_simulations"])):
+                idx, paths, leaves = [], [], []
+                for i in sorted(active):
+                    path, leaf = searches[i].search_path()
+                    idx.append(i)
+                    paths.append(path)
+                    leaves.append(leaf)
+                need_eval = [j for j, leaf in enumerate(leaves)
+                             if not leaf.done and leaf.P is None]
+                need_set = set(need_eval)
+                if need_eval:
+                    priors, values = self._evaluate_leaves(
+                        [leaves[j] for j in need_eval])
+                else:
+                    priors = values = None
+                k = 0
+                for j, (path, leaf) in enumerate(zip(paths, leaves)):
+                    if j in need_set:
+                        searches[idx[j]].expand_and_backup(
+                            path, leaf, priors[k], values[k])
+                        k += 1
+                    else:
+                        searches[idx[j]].expand_and_backup(
+                            path, leaf, None, None)
+            for i in sorted(active):
+                s = searches[i]
+                pi = s.visit_distribution()
+                if moves[i] >= int(cfg["greedy_after_moves"]):
+                    # Random tie-break: a bare argmax resolves the
+                    # all-ties case (no signal yet) to action 0 every
+                    # step, which is worse than random play.
+                    best = np.flatnonzero(pi >= pi.max() - 1e-12)
+                    a = int(self._rng.choice(best))
+                else:
+                    t = max(1e-3, float(cfg["temperature"]))
+                    p = pi ** (1.0 / t)
+                    p /= p.sum()
+                    a = int(self._rng.choice(self._num_actions, p=p))
+                episode_rows[i].append([np.asarray(s.root.obs), pi])
+                envs[i].set_state(s.root.token)
+                o, rew, done, _ = envs[i].step(a)
+                scores[i] += rew
+                moves[i] += 1
+                self._az_timesteps += 1
+                if done:
+                    self.r2.add(scores[i])
+                    z = self.r2.transform(scores[i])
+                    for row in episode_rows[i]:
+                        self._replay.append((row[0], row[1], z))
+                    self._recent_rewards.append(scores[i])
+                    self._episodes_total += 1
+                    completed += 1
+                    if completed + len(active) - 1 < num_episodes:
+                        o = envs[i].reset()
+                        scores[i] = 0.0
+                        moves[i] = 0
+                        episode_rows[i] = []
+                        searches[i].reset_root(o, 0.0)
+                    else:
+                        active.discard(i)
+                else:
+                    searches[i].advance_root(a, o, scores[i])
+        for env in envs:
+            env.close()
+
+    # -- training ------------------------------------------------------
+    def _train(self):
+        from .. import sample_batch as sb
+        from ..sample_batch import SampleBatch
+        cfg = self.config
+        self._self_play(int(cfg["episodes_per_iter"]))
+        stats = {}
+        mb = int(cfg["sgd_minibatch_size"])
+        if len(self._replay) >= mb:
+            for _ in range(int(cfg["num_sgd_iter"])):
+                rows = [self._replay[j] for j in self._rng.choice(
+                    len(self._replay), size=mb, replace=False)]
+                batch = SampleBatch({
+                    sb.OBS: np.stack([r[0] for r in rows]),
+                    sb.ACTION_DIST_INPUTS: np.stack(
+                        [r[1] for r in rows]).astype(np.float32),
+                    sb.VALUE_TARGETS: np.asarray(
+                        [r[2] for r in rows], np.float32),
+                })
+                stats = self.policy.learn_on_batch(batch)
+        rewards = list(self._recent_rewards)
+        return {
+            "episode_reward_mean": float(np.mean(rewards))
+            if rewards else float("nan"),
+            "episode_reward_max": float(np.max(rewards))
+            if rewards else float("nan"),
+            "episodes_total": self._episodes_total,
+            "timesteps_total": self._az_timesteps,
+            "timesteps_this_iter": 0,
+            "info": {"learner": stats,
+                     "replay_rows": len(self._replay)},
+        }
+
+    # -- checkpointing (parity: trainer.py:857 __getstate__) ----------
+    def _save(self, checkpoint_dir):
+        import os
+        import pickle
+        path = os.path.join(checkpoint_dir, "alpha_zero.pkl")
+        with open(path, "wb") as f:
+            pickle.dump({
+                "policy": self.policy.get_state(),
+                "r2_scores": list(self.r2.scores),
+                "episodes_total": self._episodes_total,
+                "timesteps_total": self._az_timesteps,
+            }, f)
+        return path
+
+    def _restore(self, path):
+        import pickle
+        with open(path, "rb") as f:
+            state = pickle.load(f)
+        self.policy.set_state(state["policy"])
+        self.r2.scores.extend(state["r2_scores"])
+        self._episodes_total = state["episodes_total"]
+        self._az_timesteps = state["timesteps_total"]
+
+    def _stop(self):
+        pass
+
+    def compute_action(self, obs):
+        actions, _, _ = self.policy.compute_actions(
+            np.asarray(obs)[None], explore=False)
+        return int(actions[0])

@@ -90,19 +90,30 @@ def cmd_start(args):
         agent.shutdown()
 
 
+def _load_cluster_config(path: str) -> dict:
+    import yaml
+
+    from ray_tpu.autoscaler.autoscaler import validate_cluster_config
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    return validate_cluster_config(cfg)
+
+
 def cmd_up(args):
     """Boot an autoscaling cluster from a yaml config (parity:
     `ray up cluster.yaml`, reference scripts.py:622 + autoscaler): a
-    head plus an AutoscalerMonitor launching/retiring LocalNodeProvider
-    worker nodes against load."""
-    import yaml
-
+    head plus an AutoscalerMonitor launching/retiring provider worker
+    nodes against load. The yaml is schema-validated (unknown keys are
+    an error, ref autoscaler.py:815); an `ssh:` block switches the
+    provider to CommandNodeProvider (remote hosts over ssh/any command
+    transport); `worker_types:` enables heterogeneous demand-shape
+    scaling."""
     from ray_tpu._private import node as node_mod
     from ray_tpu.autoscaler import LocalNodeProvider
     from ray_tpu.autoscaler.monitor import AutoscalerMonitor
+    from ray_tpu.autoscaler.node_provider import CommandNodeProvider
 
-    with open(args.config_file) as f:
-        cfg = yaml.safe_load(f) or {}
+    cfg = _load_cluster_config(args.config_file)
     resources = node_mod.default_resources()
     resources.update(cfg.get("head_resources") or {})
     node = node_mod.Node(resources, num_initial_workers=0,
@@ -111,22 +122,39 @@ def cmd_up(args):
     os.makedirs(PID_DIR, exist_ok=True)
     with open(ADDRESS_FILE, "w") as f:
         f.write(node.head.tcp_addr)
-    provider = LocalNodeProvider(
-        node.head.tcp_addr, node.session_dir, node.session_name,
-        node_resources=cfg.get("worker_resources") or {"CPU": 1.0},
-        name_prefix=cfg.get("cluster_name", "autoscaled"))
+    worker_types = cfg.get("worker_types") or {}
+    ssh = cfg.get("ssh")
+    if ssh:
+        provider = CommandNodeProvider(
+            node.head.tcp_addr,
+            hosts=ssh.get("hosts") or [],
+            start_command=ssh.get("start_command", ""),
+            stop_command=ssh.get("stop_command", ""),
+            setup_command=ssh.get("setup_command", ""),
+            node_resources=cfg.get("worker_resources") or {"CPU": 1.0},
+            worker_types=worker_types)
+    else:
+        provider = LocalNodeProvider(
+            node.head.tcp_addr, node.session_dir, node.session_name,
+            node_resources=cfg.get("worker_resources") or {"CPU": 1.0},
+            worker_types=worker_types,
+            name_prefix=cfg.get("cluster_name", "autoscaled"))
+    auto_cfg = {k: cfg[k] for k in ("min_workers", "max_workers",
+                                    "idle_timeout_s",
+                                    "max_launch_batch")
+                if k in cfg}
+    if worker_types:
+        auto_cfg["worker_types"] = worker_types
     monitor = AutoscalerMonitor(
-        provider,
-        {k: cfg[k] for k in ("min_workers", "max_workers",
-                             "idle_timeout_s", "max_launch_batch")
-         if k in cfg},
-        head=node.head,
+        provider, auto_cfg, head=node.head,
         update_interval_s=float(cfg.get("update_interval_s", 1.0)),
     ).start()
     print(f"cluster {cfg.get('cluster_name', '?')!r} up at "
           f"{node.head.tcp_addr} "
           f"(workers {monitor.autoscaler.config['min_workers']}-"
-          f"{monitor.autoscaler.config['max_workers']})")
+          f"{monitor.autoscaler.config['max_workers']}"
+          + (f", types {sorted(worker_types)}" if worker_types else "")
+          + (", provider ssh" if ssh else "") + ")")
     print(f"attach drivers with: "
           f"ray_tpu.init(address={node.head.tcp_addr!r})")
     _block_until_signal()
@@ -143,12 +171,93 @@ def cmd_down(args):
 def cmd_exec(args):
     """Run a shell command against the running cluster (parity:
     `ray exec`): RAY_TPU_ADDRESS is injected so `ray_tpu.init()`
-    inside the command attaches to it."""
+    inside the command attaches to it. NOTE the command runs with this
+    CLI's privileges against whatever head the address resolves to —
+    only point it at clusters you trust (the head socket is
+    unauthenticated, same trust model as the reference's redis)."""
     import subprocess
     env = dict(os.environ)
     env["RAY_TPU_ADDRESS"] = _resolve_address(args)
     rc = subprocess.call(args.command, shell=True, env=env)
     sys.exit(rc)
+
+
+def cmd_attach(args):
+    """Interactive Python session attached to the cluster (parity:
+    `ray attach`, reference scripts.py:622 — there an ssh shell onto
+    the head node; here a REPL with `ray_tpu` already connected, which
+    is the equivalent surface for a local/ssh-command cluster)."""
+    import code
+
+    address = _resolve_address(args)
+    os.environ["RAY_TPU_ADDRESS"] = address
+    import ray_tpu
+    ray_tpu.init(address=address)
+    banner = (f"ray_tpu attached to {address}\n"
+              "`ray_tpu` is imported and connected; Ctrl-D detaches.")
+    try:
+        code.interact(banner=banner, local={"ray_tpu": ray_tpu})
+    finally:
+        ray_tpu.shutdown()
+
+
+def cmd_submit(args):
+    """Run a local python script against the cluster (parity:
+    `ray submit`, reference scripts.py:692): the script executes with
+    RAY_TPU_ADDRESS set so its `ray_tpu.init()` attaches; extra args
+    after the script pass through."""
+    import subprocess
+    env = dict(os.environ)
+    env["RAY_TPU_ADDRESS"] = _resolve_address(args)
+    rc = subprocess.call(
+        [sys.executable, args.script] + (args.script_args or []),
+        env=env)
+    sys.exit(rc)
+
+
+def _rsync_template(cfg: dict, direction: str) -> str:
+    ssh = cfg.get("ssh") or {}
+    if direction == "up":
+        return ssh.get("rsync_up_command",
+                       "rsync -az {src} {host}:{dst}")
+    return ssh.get("rsync_down_command",
+                   "rsync -az {host}:{src} {dst}")
+
+
+def _cluster_hosts(cfg: dict) -> list:
+    return (cfg.get("ssh") or {}).get("hosts") or []
+
+
+def cmd_rsync(args, direction: str):
+    """File sync with cluster hosts (parity: `ray rsync-up/-down`,
+    reference scripts.py:636,650). Uses the yaml's ssh.hosts and the
+    rsync command templates ({host}/{src}/{dst} placeholders;
+    override `ssh.rsync_up_command`/`rsync_down_command` for
+    non-rsync transports). `rsync-up` syncs to EVERY host; `rsync-down`
+    pulls from the first. Without an ssh block (local provider) the
+    \"hosts\" are this machine and a plain copy is performed."""
+    import shutil
+    import subprocess
+    cfg = _load_cluster_config(args.config_file)
+    hosts = _cluster_hosts(cfg)
+    if not hosts:
+        # Local cluster: all nodes share this filesystem.
+        if os.path.isdir(args.src):
+            shutil.copytree(args.src, args.dst, dirs_exist_ok=True)
+        else:
+            os.makedirs(os.path.dirname(args.dst) or ".",
+                        exist_ok=True)
+            shutil.copy2(args.src, args.dst)
+        print(f"copied {args.src} -> {args.dst} (local cluster)")
+        return
+    template = _rsync_template(cfg, direction)
+    targets = hosts if direction == "up" else hosts[:1]
+    for host in targets:
+        cmd = template.format(host=host, src=args.src, dst=args.dst)
+        print(f"[{host}] {cmd}")
+        rc = subprocess.call(cmd, shell=True)
+        if rc != 0:
+            sys.exit(rc)
 
 
 def _session_name(address: str) -> str:
@@ -310,6 +419,26 @@ def main(argv=None):
     p.add_argument("command")
     p.add_argument("--address", default=None)
     p.set_defaults(fn=cmd_exec)
+
+    p = sub.add_parser("attach",
+                       help="interactive session on the cluster")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_attach)
+
+    p = sub.add_parser("submit",
+                       help="run a local script against the cluster")
+    p.add_argument("script")
+    p.add_argument("script_args", nargs=argparse.REMAINDER)
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_submit)
+
+    for direction in ("up", "down"):
+        p = sub.add_parser(f"rsync-{direction}",
+                           help=f"sync files {direction} cluster hosts")
+        p.add_argument("config_file")
+        p.add_argument("src")
+        p.add_argument("dst")
+        p.set_defaults(fn=lambda a, _d=direction: cmd_rsync(a, _d))
 
     for name, fn in (("stat", cmd_stat), ("memory", cmd_memory),
                      ("timeline", cmd_timeline)):

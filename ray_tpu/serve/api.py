@@ -229,14 +229,46 @@ class _Router:
             rec["outstanding"] -= 1
             self._free.notify_all()
 
-    def route_call(self, endpoint: str, request):
-        with self._lock:
-            backend = self._pick_backend_locked(endpoint)
-        rec = self._acquire_replica(backend)
-        try:
-            return ray_tpu.get(rec["handle"].handle.remote(request))
-        finally:
-            self._release_replica(rec)
+    def _replace_dead_replica(self, backend: str, rec: dict):
+        """Drop a dead replica record and spawn its replacement so the
+        backend returns to its configured replica count (parity: the
+        reference's backend-worker supervision; queries never route to
+        a replica observed dead)."""
+        with self._free:
+            b = self.backends.get(backend)
+            if b is None or rec not in b["replicas"]:
+                return  # already replaced (concurrent observer) / gone
+            b["replicas"].remove(rec)
+            fb, fa, fk = b["factory"]
+            cls = ray_tpu.remote(_Replica)
+            b["replicas"].append(
+                {"handle": cls.options(num_cpus=0).remote(
+                    fb, list(fa), dict(fk)),
+                 "outstanding": 0})
+            self._free.notify_all()
+
+    def route_call(self, endpoint: str, request, _max_attempts: int = 3):
+        """Route one query. A replica dying mid-query is NOT a client
+        error: the router replaces the dead replica and retries the
+        query on another (at-most `_max_attempts` tries, so a request
+        may execute more than once on replica death — same at-least-
+        once caveat as any retrying proxy; make handlers idempotent if
+        that matters). Handler EXCEPTIONS propagate without retry."""
+        from ray_tpu.exceptions import (ActorDiedError,
+                                        ActorUnavailableError)
+        last_err = None
+        for _ in range(_max_attempts):
+            with self._lock:
+                backend = self._pick_backend_locked(endpoint)
+            rec = self._acquire_replica(backend)
+            try:
+                return ray_tpu.get(rec["handle"].handle.remote(request))
+            except (ActorDiedError, ActorUnavailableError) as e:
+                last_err = e
+                self._replace_dead_replica(backend, rec)
+            finally:
+                self._release_replica(rec)
+        raise last_err
 
     # -- HTTP frontend ---------------------------------------------------
     def _start_http(self, host: str, port: int):

@@ -12,20 +12,37 @@ across the downstream operator's parallel instances.
 Flow control (parity: the bounded ring buffers of
 `streaming/src/ring_buffer.cc` + `data_writer.cc` backpressure): every
 edge carries at most `credits` unprocessed items. Each sender retains
-the result refs of its pushes per downstream instance; at the credit
+(ref, item, key) for its pushes per downstream instance; at the credit
 limit it blocks on the OLDEST ref (ordered actor streams complete
 in order) before pushing more, so a fast source stalls against a slow
 sink instead of growing an unbounded queue — back-pressure propagates
 hop by hop up to the driver's source loop.
+
+Failure recovery (parity: `streaming/src/data_writer.cc` channel
+recreation on reader/writer restart): operator actors run with
+`max_restarts` (default `RAY_TPU_STREAMING_OPERATOR_RESTARTS`); the
+sender's credit window doubles as the redelivery buffer. When a
+drain observes the downstream instance died, the sender REPLAYS every
+undrained in-flight item, in order, against the restarted actor —
+**at-least-once** delivery: an item whose `process` completed on the
+dead instance just before the crash is replayed and may be processed
+twice (exactly the reference data plane's contract; make sinks/
+reducers idempotent or key results if that matters). Operator STATE
+(`reduce` accumulators, sink buffers) restarts empty — state
+persistence is the application's job, same as the reference's. A
+downstream that exhausts its restart budget fails the pipeline with
+the underlying `ActorDiedError`.
 """
 
 from __future__ import annotations
 
+import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional
 
 import ray_tpu
 from ray_tpu._private import config as _config
+from ray_tpu.exceptions import ActorDiedError, ActorUnavailableError
 
 
 def _default_credits() -> int:
@@ -100,10 +117,14 @@ class _OperatorActor:
         ordered after every push its caller made, and it returns only
         when the whole downstream DAG has flushed — so when the DRIVER's
         flush of the source stage returns, every item has fully
-        propagated (the reference's channel flush semantics)."""
-        import ray_tpu as _ray
+        propagated (the reference's channel flush semantics). Drains
+        this instance's own credit windows first so a downstream death
+        replays them before the barrier passes."""
+        for handle, inflight in zip(self.downstream, self._inflight):
+            while inflight:
+                _drain_oldest(handle, inflight)
         if self.downstream:
-            _ray.get([d.flush.remote() for d in self.downstream])
+            flush_with_retry(self.downstream)
         return "ok"
 
     def sink_values(self):
@@ -113,14 +134,64 @@ class _OperatorActor:
         return dict(self._state)
 
 
+def _drain_oldest(handle, inflight: deque,
+                  redeliver_timeout_s: float = 30.0):
+    """Complete the oldest in-flight push; on downstream death, replay
+    every undrained item (module doc: at-least-once) against the
+    restarted actor, retrying until it comes back or the redelivery
+    budget is exhausted. The get itself is UNBOUNDED — a slow-but-alive
+    downstream is backpressure, not failure (the documented stall
+    contract); only an observed actor death starts the redelivery
+    clock."""
+    deadline = None
+    while True:
+        ref, item, key = inflight[0]
+        try:
+            ray_tpu.get(ref)
+            inflight.popleft()
+            return
+        except (ActorDiedError, ActorUnavailableError):
+            now = time.monotonic()
+            if deadline is None:
+                deadline = now + redeliver_timeout_s
+            elif now > deadline:
+                raise
+            # Redeliver the whole undrained window in order.
+            time.sleep(0.2)
+            replay = [(handle.process.remote(it, k), it, k)
+                      for _, it, k in inflight]
+            inflight.clear()
+            inflight.extend(replay)
+        # Task-level errors (user fn raised) are not delivery
+        # failures; they propagate out of the get above.
+
+
 def push_with_credits(handle, inflight: deque, credits: int,
                       item, key=None):
     """Ordered push bounded by the edge's credit window: at the limit,
     block on the oldest outstanding push (completes first — actor
-    streams are ordered) before issuing the next."""
+    streams are ordered) before issuing the next. The window entries
+    retain (ref, item, key) so a downstream death can replay them."""
     while len(inflight) >= credits:
-        ray_tpu.get(inflight.popleft())
-    inflight.append(handle.process.remote(item, key))
+        _drain_oldest(handle, inflight)
+    inflight.append((handle.process.remote(item, key), item, key))
+
+
+def flush_with_retry(handles, timeout_s: float = 30.0):
+    """Barrier over possibly-restarting downstream actors: a flush that
+    dies mid-restart is retried until the actor returns or the budget
+    is exhausted."""
+    deadline = time.monotonic() + timeout_s
+    pending = list(handles)
+    while pending:
+        try:
+            ray_tpu.get([h.flush.remote() for h in pending],
+                        timeout=timeout_s)
+            return
+        except (ActorDiedError, ActorUnavailableError):
+            if time.monotonic() > deadline:
+                raise
+            time.sleep(0.2)
 
 
 class DataStream:
@@ -171,14 +242,19 @@ class ExecutionGraph:
     def run(self):
         """Push every source item through, then flush the DAG. The
         source loop itself respects the credit window: a slow sink
-        stalls THIS loop, not an unbounded in-cluster queue."""
+        stalls THIS loop, not an unbounded in-cluster queue. A stage
+        instance dying mid-run is redelivered to after restart
+        (module doc: at-least-once)."""
         first = self.stage_actors[0]
         inflight = [deque() for _ in first]
         for i, item in enumerate(self._source_items):
             j = i % len(first)
             push_with_credits(first[j], inflight[j], self._credits,
                               item)
-        ray_tpu.get([a.flush.remote() for a in first])
+        for j, a in enumerate(first):
+            while inflight[j]:
+                _drain_oldest(a, inflight[j])
+        flush_with_retry(first)
         return self
 
     def sink_values(self) -> List:
@@ -196,8 +272,14 @@ class ExecutionGraph:
 
 
 class StreamingContext:
-    def __init__(self, credits: int = None):
-        self._cls = ray_tpu.remote(_OperatorActor)
+    def __init__(self, credits: int = None,
+                 max_operator_restarts: int = None):
+        restarts = (max_operator_restarts
+                    if max_operator_restarts is not None
+                    else _config.get(
+                        "RAY_TPU_STREAMING_OPERATOR_RESTARTS"))
+        self._cls = ray_tpu.remote(_OperatorActor).options(
+            max_restarts=restarts)
         self._credits = max(1, credits if credits is not None
                             else _default_credits())
 

@@ -93,3 +93,139 @@ class TestApexQMIX:
         # QMIX tests; here the distributed-replay plumbing must sample,
         # replay, and train without losing the signal entirely.
         assert reward is not None and reward > 5.0, reward
+
+
+class _ChainEnv:
+    """Deterministic 3-step chain: action 1 pays 1.0 at every step,
+    action 0 pays nothing. State-cloneable for MCTS."""
+
+    def __init__(self):
+        from ray_tpu.rllib.env.spaces import Box, Discrete
+        self.observation_space = Box(0.0, 3.0, shape=(1,),
+                                     dtype=np.float32)
+        self.action_space = Discrete(2)
+        self._t = 0
+
+    def reset(self):
+        self._t = 0
+        return np.array([0.0], np.float32)
+
+    def step(self, action):
+        rew = 1.0 if action == 1 else 0.0
+        self._t += 1
+        done = self._t >= 3
+        return np.array([float(self._t)], np.float32), rew, done, {}
+
+    def get_state(self):
+        return self._t
+
+    def set_state(self, token):
+        self._t = token
+        return np.array([float(self._t)], np.float32)
+
+    def seed(self, seed=None):
+        pass
+
+    def close(self):
+        pass
+
+
+class TestAlphaZero:
+    def test_mcts_prefers_rewarding_branch(self):
+        """With a distinguishing R2 buffer and uniform priors, PUCT
+        search concentrates visits on the always-rewarding action."""
+        from ray_tpu.rllib.contrib.alpha_zero import (MCTS,
+                                                      RankedRewardsBuffer)
+        env = _ChainEnv()
+        r2 = RankedRewardsBuffer(10, 75.0)
+        for s in (0.0, 1.0, 2.0, 3.0):
+            r2.add(s)
+        mcts = MCTS(env, 2, c_puct=1.25, r2=r2,
+                    rng=np.random.default_rng(0),
+                    dirichlet_alpha=0.3, dirichlet_epsilon=0.0)
+        obs = env.reset()
+        mcts.reset_root(obs, 0.0)
+        for _ in range(60):
+            path, leaf = mcts.search_path()
+            if leaf.done or leaf.P is not None:
+                mcts.expand_and_backup(path, leaf, None, None)
+            else:
+                mcts.expand_and_backup(
+                    path, leaf, np.array([0.5, 0.5]), 0.0)
+        pi = mcts.visit_distribution()
+        assert pi[1] > 0.7, pi
+
+    def test_ranked_rewards_transform(self):
+        from ray_tpu.rllib.contrib.alpha_zero import RankedRewardsBuffer
+        r2 = RankedRewardsBuffer(100, 75.0)
+        for s in range(1, 101):
+            r2.add(float(s))
+        assert r2.transform(90.0) == 1.0
+        assert r2.transform(10.0) == -1.0
+
+    def test_registry_and_state_check(self, ray_session):
+        cls = get_trainer_class("contrib/AlphaZero")
+        with pytest.raises(ValueError, match="get_state"):
+            cls(config={"env": "Pendulum-v0"})
+
+    def test_learns_cartpole(self, ray_session):
+        """Regression-by-learning (SURVEY §4.2): MCTS self-play +
+        ranked rewards beats random CartPole play quickly. Random play
+        on max_steps=50 CartPole scores ~20-25; the search alone (with
+        a learning value/prior net) should push past 40."""
+        t = get_trainer_class("contrib/AlphaZero")(config={
+            "env": "StatefulCartPole-v0",
+            "env_config": {"max_steps": 50},
+            "num_envs_per_worker": 4,
+            "episodes_per_iter": 4,
+            "mcts_num_simulations": 25,
+            # CartPole dies fast: high-temperature exploration moves
+            # must stay short or they doom the pole before search can
+            # steer (games like Go afford 15+ exploratory moves).
+            "greedy_after_moves": 4,
+            "temperature": 0.7,
+            # Survival task: in-search deaths are always bad (see the
+            # mcts_terminal_value config doc).
+            "mcts_terminal_value": "failure",
+            "sgd_minibatch_size": 64,
+            "num_sgd_iter": 4,
+            "model": {"fcnet_hiddens": [32, 32]},
+            "seed": 0,
+        })
+        best = 0.0
+        for _ in range(6):
+            r = t.train()
+            rew = r.get("episode_reward_mean")
+            if rew == rew:
+                best = max(best, rew)
+            if best >= 40:
+                break
+        t.stop()
+        assert best >= 40, f"AlphaZero failed to beat random: {best}"
+
+    def test_checkpoint_roundtrip(self, ray_session, tmp_path):
+        cls = get_trainer_class("contrib/AlphaZero")
+        cfg = {
+            "env": "StatefulCartPole-v0",
+            "env_config": {"max_steps": 20},
+            "num_envs_per_worker": 2,
+            "episodes_per_iter": 2,
+            "mcts_num_simulations": 8,
+            "sgd_minibatch_size": 16,
+            "num_sgd_iter": 1,
+            "model": {"fcnet_hiddens": [16]},
+            "seed": 0,
+        }
+        t = cls(config=cfg)
+        t.train()
+        path = t.save(str(tmp_path))
+        w0 = t.policy.get_weights()
+        t.stop()
+        t2 = cls(config=cfg)
+        t2.restore(path)
+        w1 = t2.policy.get_weights()
+        import jax
+        for a, b in zip(jax.tree.leaves(w0), jax.tree.leaves(w1)):
+            np.testing.assert_allclose(a, b)
+        t2.train()  # keeps training after restore
+        t2.stop()

@@ -1,0 +1,127 @@
+"""Memory monitor: typed low-memory errors + head placement gating.
+
+Parity: `python/ray/memory_monitor.py:64` (RayOutOfMemoryError before
+the OOM killer) + the raylet heartbeat resource view that keeps work
+off distressed nodes. Tests lower the threshold below current usage
+instead of actually exhausting RAM.
+"""
+
+import time
+
+import pytest
+
+import ray_tpu
+from ray_tpu._private.memory_monitor import (MemoryMonitor,
+                                             get_memory_usage)
+from ray_tpu.exceptions import RayOutOfMemoryError, TaskError
+
+
+class TestMonitor:
+    def test_usage_readout_sane(self):
+        used, total = get_memory_usage()
+        assert 0 < used <= total
+        assert total > 100e6  # a real machine
+
+    def test_threshold_raises_with_process_table(self):
+        m = MemoryMonitor(error_threshold=0.0001, check_interval_s=0.0)
+        with pytest.raises(RayOutOfMemoryError, match="pid="):
+            m.raise_if_low_memory("test-task")
+
+    def test_healthy_threshold_passes(self):
+        m = MemoryMonitor(error_threshold=1.01, check_interval_s=0.0)
+        m.raise_if_low_memory()
+
+    def test_disabled_by_nonpositive_threshold(self):
+        m = MemoryMonitor(error_threshold=0.0)
+        assert m.disabled
+        m.raise_if_low_memory()
+
+    def test_throttling(self):
+        m = MemoryMonitor(error_threshold=0.0001, check_interval_s=60.0)
+        with pytest.raises(RayOutOfMemoryError):
+            m.raise_if_low_memory()
+        # Within the interval: no re-check, no raise.
+        m.raise_if_low_memory()
+
+
+class TestEndToEnd:
+    def test_task_fails_typed_not_node_death(self, monkeypatch):
+        """A memory-hog task produces RayOutOfMemoryError as the
+        TaskError cause; the worker and node survive and later tasks
+        run fine once pressure clears (threshold restored)."""
+        monkeypatch.setenv("RAY_TPU_MEMORY_USAGE_THRESHOLD", "0.0001")
+        ray_tpu.init(num_cpus=2)
+        try:
+            @ray_tpu.remote
+            def work():
+                return 42
+
+            ref = work.remote()
+            with pytest.raises(TaskError) as ei:
+                ray_tpu.get(ref, timeout=60)
+            assert "RayOutOfMemoryError" in str(ei.value)
+        finally:
+            ray_tpu.shutdown()
+            monkeypatch.delenv("RAY_TPU_MEMORY_USAGE_THRESHOLD")
+        # Node survived: a fresh session on the same machine works.
+        ray_tpu.init(num_cpus=2)
+        try:
+            @ray_tpu.remote
+            def ok():
+                return 7
+
+            assert ray_tpu.get(ok.remote(), timeout=60) == 7
+        finally:
+            ray_tpu.shutdown()
+
+    def test_head_gates_placement_on_low_memory_node(self):
+        """A node reporting mem_frac above threshold takes no new
+        placements (NodeInfo.fits False) and recovers when it drops."""
+        from ray_tpu._private.head import NodeInfo
+        n = NodeInfo("n1", {"CPU": 4.0})
+        assert n.fits({"CPU": 1.0})
+        n.low_memory = True
+        assert not n.fits({"CPU": 1.0})
+        assert n.view()["low_memory"] is True
+        n.low_memory = False
+        assert n.fits({"CPU": 1.0})
+
+    def test_heartbeat_sets_low_memory_flag(self):
+        """End-to-end: an agent heartbeat with a high mem_frac flips
+        the head's gate; a healthy one clears it."""
+        ray_tpu.init(num_cpus=1)
+        try:
+            from ray_tpu._private import node as node_mod
+            head = node_mod._node.head
+            # Synthesize a joined node entry.
+            from ray_tpu._private.head import NodeInfo
+            with head._lock:
+                head._nodes["memtest"] = NodeInfo(
+                    "memtest", {"CPU": 2.0})
+
+            class FakeConn:
+                pass
+
+            head._h_heartbeat(FakeConn(), {
+                "node_id": "memtest", "mem_frac": 0.99})
+            assert head._nodes["memtest"].low_memory
+            head._h_heartbeat(FakeConn(), {
+                "node_id": "memtest", "mem_frac": 0.10})
+            assert not head._nodes["memtest"].low_memory
+            with head._lock:
+                del head._nodes["memtest"]
+        finally:
+            ray_tpu.shutdown()
+
+
+def test_cluster_load_and_dashboard_surface_memory():
+    ray_tpu.init(num_cpus=1)
+    try:
+        from ray_tpu._private import node as node_mod
+        from ray_tpu._private.dashboard import render
+        load = node_mod._node.head.cluster_load()
+        assert all("mem_frac" in n for n in load["nodes"])
+        page = render(node_mod._node.head)
+        assert "mem" in page
+    finally:
+        ray_tpu.shutdown()

