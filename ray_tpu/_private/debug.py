@@ -1,0 +1,95 @@
+"""Threaded-runtime debugging aids: stall watchdog + stack dumps.
+
+The reference ships TSAN build configs (`.bazelrc:33-40`) and valgrind
+harnesses (`src/ray/test/run_object_manager_valgrind.sh`) for its C++
+daemons, plus a glog failure handler that prints stacks on crashes
+(`src/ray/raylet/main.cc:39`). The analog for THIS runtime's failure
+mode — Python threads deadlocking or wedging rather than corrupting
+memory — is visibility into every thread's stack:
+
+- `install_signal_dump()`: SIGUSR1 dumps all thread stacks to stderr
+  (faulthandler), so a wedged daemon can be inspected from outside
+  (`kill -USR1 <pid>`), the moral equivalent of attaching gdb to a
+  stuck raylet. Installed by every head/agent/worker at boot.
+- `StallWatchdog`: a heartbeat the OWNING thread must touch; if it
+  goes quiet for `timeout_s` the watchdog dumps all stacks once and
+  keeps running (detection, not recovery — the soak/chaos harness
+  asserts the dump machinery itself stays quiet in healthy runs).
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import signal
+import sys
+import threading
+import time
+
+_installed = False
+
+
+def install_signal_dump() -> None:
+    """Register SIGUSR1 -> all-thread stack dump (idempotent; main
+    thread only — signal handlers can't install elsewhere)."""
+    global _installed
+    if _installed or threading.current_thread() \
+            is not threading.main_thread():
+        return
+    try:
+        if signal.getsignal(signal.SIGUSR1) not in (
+                signal.SIG_DFL, None):
+            return  # the application owns SIGUSR1; don't steal it
+        # chain=False: the disposition is SIG_DFL (terminate) —
+        # chaining would kill the process after the dump.
+        faulthandler.register(signal.SIGUSR1, all_threads=True,
+                              chain=False)
+        _installed = True
+    except (ValueError, AttributeError, OSError):
+        pass  # non-main interpreter / unsupported platform
+
+
+class StallWatchdog:
+    """Dump all thread stacks when the watched loop stops beating.
+
+    Usage: the monitored loop calls `beat()` each iteration; a daemon
+    thread checks the gap. One dump per stall (re-armed by the next
+    beat) keeps logs readable.
+    """
+
+    def __init__(self, name: str, timeout_s: float = 60.0,
+                 out=None):
+        self.name = name
+        self.timeout_s = timeout_s
+        self._out = out or sys.stderr
+        self._last = time.monotonic()
+        self._dumped = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"stall-watchdog-{name}")
+        self._thread.start()
+
+    def beat(self) -> None:
+        self._last = time.monotonic()
+        self._dumped = False
+
+    @property
+    def stalled(self) -> bool:
+        return time.monotonic() - self._last > self.timeout_s
+
+    def _run(self):
+        while not self._stop.wait(min(5.0, self.timeout_s / 4)):
+            if self.stalled and not self._dumped:
+                self._dumped = True
+                print(f"[ray_tpu] STALL: {self.name!r} silent for "
+                      f">{self.timeout_s:.0f}s; thread stacks follow",
+                      file=self._out, flush=True)
+                try:
+                    faulthandler.dump_traceback(file=self._out,
+                                                all_threads=True)
+                except Exception:  # noqa: BLE001 — best-effort dump
+                    pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)

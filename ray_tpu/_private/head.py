@@ -283,7 +283,8 @@ class HeadServer:
             if not (msg.get("overwrite", True) is False and exists):
                 self._kv[msg["key"]] = msg["value"]
         if "seq" in msg:
-            conn.reply(msg, ok=not exists or msg.get("overwrite", True))
+            conn.reply(msg, ok=not exists or msg.get("overwrite", True),
+                       existed=exists)
 
     def _h_kv_get(self, conn, msg):
         with self._lock:
@@ -301,6 +302,41 @@ class HeadServer:
         with self._lock:
             keys = [k for k in self._kv if k.startswith(prefix)]
         conn.reply(msg, keys=keys)
+
+    def _h_set_resource(self, conn, msg):
+        """Live per-node resource adjustment (parity:
+        `python/ray/experimental/dynamic_resources.py` set_resource +
+        the GCS DynamicResourceTable, `tables.h:647`): retunes the
+        node's capacity; in-use amounts are preserved (available moves
+        by the capacity delta, possibly below zero until tasks
+        finish). capacity == 0 deletes the resource."""
+        name = msg["resource"]
+        capacity = float(msg["capacity"])
+        node_id = msg.get("node_id") or "node0"
+        with self._lock:
+            node = self._nodes.get(node_id)
+            if node is None or not node.alive:
+                conn.reply(msg, ok=False,
+                           message=f"no live node {node_id!r}")
+                return
+            old = node.total.get(name, 0.0)
+            if capacity <= 0:
+                # Deletion must keep in-use amounts as debt: dropping
+                # `available` outright would let running tasks' release
+                # resurrect phantom capacity on a deleted resource.
+                node.total.pop(name, None)
+                remaining = node.available.get(name, 0.0) - old
+                if remaining == 0:
+                    node.available.pop(name, None)
+                else:
+                    node.available[name] = remaining
+            else:
+                node.total[name] = capacity
+                node.available[name] = node.available.get(name, 0.0) \
+                    + (capacity - old)
+            self._schedule_locked()
+            self._serve_lease_queue_locked()
+        conn.reply(msg, ok=True)
 
     def _h_subscribe(self, conn, msg):
         with self._lock:
@@ -328,6 +364,13 @@ class HeadServer:
                             " pausing new placements on it",
                             node.node_id, 100 * node.mem_frac,
                             100 * self._memory_threshold)
+                    elif was_low and not node.low_memory:
+                        # Recovery: work queued while the node was
+                        # gated has no other wake-up edge (no task
+                        # completion, no new submission) — kick the
+                        # scheduler now.
+                        self._schedule_locked()
+                        self._serve_lease_queue_locked()
 
     # -- metrics (reference: src/ray/stats/ + reporter.py) ---------------
     def _h_metrics_push(self, conn, msg):

@@ -53,6 +53,8 @@ class Node:
                  enable_tcp: bool = False):
         ts = datetime.datetime.now().strftime("%Y%m%d-%H%M%S")
         self.session_name = f"{ts}-{os.getpid()}-{os.urandom(2).hex()}"
+        from .debug import install_signal_dump
+        install_signal_dump()
         # Note: deliberately NOT "<tmp>/ray_tpu" — a directory named like the
         # package next to a user's cwd would shadow the real package as a
         # namespace package.

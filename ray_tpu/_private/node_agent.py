@@ -52,6 +52,10 @@ class NodeAgent:
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
+        # SIGUSR1 -> all-thread stack dump (debug.py; the runtime's
+        # TSAN/gdb-attach analog for wedged daemons).
+        from .debug import install_signal_dump
+        install_signal_dump()
 
         self.head = protocol.connect(
             head_addr, f"agent:{node_id}", self._handle,

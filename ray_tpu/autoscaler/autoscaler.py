@@ -145,13 +145,26 @@ class StandardAutoscaler:
             if fully_idle and \
                     self.load_metrics.idle_seconds(nid) > idle_timeout:
                 removable.append(nid)
+        get_type = getattr(self.provider, "node_type", lambda nid: None)
+        type_mins = {name: int(spec.get("min_workers", 0))
+                     for name, spec in (self.config.get("worker_types")
+                                        or {}).items()}
+        counts_now = self._nodes_by_type(nodes)
         for nid in removable:
             if len(nodes) <= min_w:
                 break
+            ntype = get_type(nid)
+            # Per-type floor: terminating below it would just trigger
+            # the next tick's bringup (terminate/relaunch churn).
+            if ntype is not None and \
+                    counts_now.get(ntype, 0) <= type_mins.get(ntype, 0):
+                continue
             logger.info("autoscaler: terminating idle node %s", nid)
             self.provider.terminate_node(nid)
             self.num_terminations += 1
             nodes.remove(nid)
+            if ntype is not None:
+                counts_now[ntype] -= 1
 
         # -- scale up --------------------------------------------------
         max_w = int(self.config["max_workers"])

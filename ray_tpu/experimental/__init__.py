@@ -2,6 +2,7 @@
 
 from .actor_pool import ActorPool
 from .async_api import as_future
+from .dynamic_resources import set_resource
 from .iter import (LocalIterator, ParallelIterator, from_items,
                    from_iterators, from_range)
 from .multiprocessing import Pool
@@ -10,5 +11,5 @@ from .queue import Empty, Full, Queue
 __all__ = [
     "ActorPool", "Empty", "Full", "LocalIterator", "ParallelIterator",
     "Pool", "Queue", "as_future", "from_items", "from_iterators",
-    "from_range",
+    "from_range", "set_resource",
 ]

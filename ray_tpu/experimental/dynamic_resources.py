@@ -1,0 +1,27 @@
+"""Live per-node custom resources.
+
+Parity: `python/ray/experimental/dynamic_resources.py` `set_resource`
+(backed by the GCS DynamicResourceTable, `src/ray/gcs/tables.h:647`) —
+create, retune, or delete a custom resource on a live node; queued
+tasks demanding it schedule as soon as capacity appears.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .._private import worker_state
+
+
+def set_resource(resource_name: str, capacity: float,
+                 node_id: Optional[str] = None) -> None:
+    """Set `resource_name`'s capacity on `node_id` (default: the head
+    node, "node0"). capacity=0 deletes the resource. Amounts already
+    acquired by running tasks are preserved — shrinking below usage
+    drives availability negative until they finish."""
+    rt = worker_state.get_runtime()
+    reply = rt.head.request(
+        {"kind": "set_resource", "resource": resource_name,
+         "capacity": float(capacity), "node_id": node_id}, timeout=30)
+    if not reply.get("ok"):
+        raise ValueError(reply.get("message", "set_resource failed"))

@@ -1,0 +1,67 @@
+"""Internal key-value store client.
+
+Parity: `python/ray/experimental/internal_kv.py` — the reference backs
+this by Redis; here it is the head's KV table (`head.py:_h_kv_put`),
+the same store `function_manager` exports ride on. Values are bytes or
+any picklable object.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from .._private import worker_state
+
+
+def _head():
+    return worker_state.get_runtime().head
+
+
+def _internal_kv_initialized() -> bool:
+    try:
+        worker_state.get_runtime()
+        return True
+    except Exception:  # noqa: BLE001 — "not connected" probes
+        return False
+
+
+def _internal_kv_put(key: str, value, overwrite: bool = True) -> bool:
+    """Store key -> value; returns True iff the key already existed
+    (reference semantics). With overwrite=False an existing value is
+    left untouched."""
+    reply = _head().request(
+        {"kind": "kv_put", "key": "ikv:" + key, "value": value,
+         "overwrite": overwrite}, timeout=30)
+    return bool(reply.get("existed"))
+
+
+def _internal_kv_get(key: str):
+    return _head().request(
+        {"kind": "kv_get", "key": "ikv:" + key}, timeout=30)["value"]
+
+
+def _internal_kv_exists(key: str) -> bool:
+    # Real key presence (a stored None value still exists): ask the
+    # key table, not get()-and-compare.
+    keys = _head().request(
+        {"kind": "kv_keys", "prefix": "ikv:" + key}, timeout=30)["keys"]
+    return ("ikv:" + key) in keys
+
+
+def _internal_kv_del(key: str) -> None:
+    _head().request({"kind": "kv_del", "key": "ikv:" + key}, timeout=30)
+
+
+def _internal_kv_list(prefix: str) -> List[str]:
+    keys = _head().request(
+        {"kind": "kv_keys", "prefix": "ikv:" + prefix},
+        timeout=30)["keys"]
+    return [k[len("ikv:"):] for k in keys]
+
+
+# Public-style aliases (the reference exposes the underscored names).
+kv_put = _internal_kv_put
+kv_get = _internal_kv_get
+kv_del = _internal_kv_del
+kv_list = _internal_kv_list
+kv_exists = _internal_kv_exists

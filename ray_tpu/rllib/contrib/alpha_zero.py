@@ -238,14 +238,19 @@ class MCTS:
             self.A, 1.0 / self.A)
 
     def advance_root(self, action: int, obs, score: float) -> None:
-        """Reuse the chosen child's subtree for the next move."""
+        """Reuse the chosen child's subtree for the next move. The
+        reused root gets FRESH Dirichlet noise (AlphaZero re-noises
+        every move's root — without it, root exploration collapses
+        after move 1 whenever the subtree is reused)."""
         child = self.root.children.get(int(action))
         if child is None or child.P is None:
             self.reset_root(obs, score)
         else:
             self.root = child
-            # Fresh Dirichlet noise applies at the new root next expand;
-            # existing priors stay (standard subtree reuse).
+            if self.eps > 0:
+                noise = self.rng.dirichlet([self.alpha] * self.A)
+                self.root.P = (1 - self.eps) * self.root.P \
+                    + self.eps * noise
 
 
 def alpha_zero_loss(policy, params, batch, rng, loss_state):

@@ -149,12 +149,20 @@ class DeviceSebulbaSampler:
 
         if self.delta:
             shape = self._frame_shape
+            K = int(self.env.delta_budget)
 
-            def delta_step_fn(params, stack, frames, idx, val, done, rng,
+            def delta_step_fn(params, stack, frames, packed, rng,
                               explore):
-                # frames: [N, HW] uint8 retained on device; idx/val:
-                # [N, K] sparse delta (pad idx == HW dropped).
+                # frames: [N, HW] uint8 retained on device. packed:
+                # [N, 3K+1] uint8 — ONE upload per step carrying the
+                # sparse delta and done flags (layout in _pack_step:
+                # idx as little-endian uint16 pairs | val | done).
+                # Fewer per-step transfers matter on high-RTT links.
                 n = frames.shape[0]
+                idx = jax.lax.bitcast_convert_type(
+                    packed[:, :2 * K].reshape(n, K, 2), jnp.uint16)
+                val = packed[:, 2 * K:3 * K]
+                done = packed[:, 3 * K] != 0
                 frames = frames.at[
                     jnp.arange(n)[:, None], idx.astype(jnp.int32)].set(
                         val, mode="drop")
@@ -168,6 +176,15 @@ class DeviceSebulbaSampler:
             self._step_fn = jax.jit(delta_step_fn, donate_argnums=(2,))
         else:
             self._step_fn = jax.jit(stack_and_infer)
+
+    def _pack_step(self, idx: np.ndarray, val: np.ndarray,
+                   done: np.ndarray) -> np.ndarray:
+        """One contiguous uint8 buffer per step (layout read back by
+        `delta_step_fn`): [idx as LE uint16 bytes | val | done]."""
+        assert idx.dtype == np.uint16
+        return np.concatenate(
+            [np.ascontiguousarray(idx).view(np.uint8),
+             val, done.astype(np.uint8)[:, None]], axis=1)
 
     def _full_fn(self, b: int):
         """Bucketed whole-row replacement: rows [b] int32 (pad == N,
@@ -188,8 +205,6 @@ class DeviceSebulbaSampler:
         """
         policy = self.policy
         done = self._host_done
-        done_d = jax.device_put(done, policy._bsharded)
-        self.bytes_h2d += done.nbytes
         if self.delta:
             ds = self._host_delta
             if ds is not None and len(ds.full_rows):
@@ -217,18 +232,19 @@ class DeviceSebulbaSampler:
                 idx, val = pad.idx, pad.val
             else:
                 idx, val = ds.idx, ds.val
-            idx_d = jax.device_put(idx, policy._bsharded)
-            val_d = jax.device_put(val, policy._bsharded)
-            self.bytes_h2d += idx.nbytes + val.nbytes
+            packed = self._pack_step(idx, val, done)
+            packed_d = jax.device_put(packed, policy._bsharded)
+            self.bytes_h2d += packed.nbytes
             with policy._update_lock:
                 self._pending = self._step_fn(
-                    policy.params, self._stack, self._frames_d, idx_d,
-                    val_d, done_d, policy._next_rng(), self.explore)
+                    policy.params, self._stack, self._frames_d,
+                    packed_d, policy._next_rng(), self.explore)
             self._frames_d = self._pending[5]
         else:
             frame = self._host_obs
             frame_d = jax.device_put(frame, policy._bsharded)
-            self.bytes_h2d += frame.nbytes
+            done_d = jax.device_put(done, policy._bsharded)
+            self.bytes_h2d += frame.nbytes + done.nbytes
             with policy._update_lock:
                 self._pending = self._step_fn(
                     policy.params, self._stack, frame_d, done_d,

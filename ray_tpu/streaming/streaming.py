@@ -58,10 +58,24 @@ def _stable_hash(key) -> int:
 
 
 class _OperatorActor:
-    """One parallel instance of one operator stage."""
+    """One parallel instance of one operator stage.
+
+    With a `checkpoint_dir`, operator STATE (reduce accumulators,
+    window buffers, sink values) survives actor restarts through the
+    framework's `Checkpointable` protocol (`actor.py:186`): the
+    runtime checkpoints every `checkpoint_interval` processed items
+    and restores the newest checkpoint after a restart — so a killed
+    reduce resumes its accumulators instead of restarting empty, and
+    the sender's at-least-once replay (module doc) only re-applies the
+    post-checkpoint tail. Without a checkpoint_dir the protocol is
+    dormant (`should_checkpoint` False) and state restarts empty.
+    """
 
     def __init__(self, kind: str, fn_bytes, downstream_handles,
-                 instance_id: int, credits: int = None):
+                 instance_id: int, credits: int = None,
+                 checkpoint_dir: str = None,
+                 checkpoint_interval: int = 100,
+                 window_size: int = 0):
         import cloudpickle
         self.kind = kind
         self.fn = cloudpickle.loads(fn_bytes) if fn_bytes else None
@@ -73,8 +87,13 @@ class _OperatorActor:
         self._inflight: List[deque] = [deque()
                                        for _ in downstream_handles]
         self._state: Dict[Any, Any] = {}  # key -> accumulated value
+        self._windows: Dict[Any, list] = {}  # key -> buffered items
+        self._window_size = int(window_size)
         self._sink: List[Any] = []
         self._rr = 0
+        self._ckpt_dir = checkpoint_dir
+        self._ckpt_interval = max(1, int(checkpoint_interval))
+        self._since_ckpt = 0
 
     # -- data plane ------------------------------------------------------
     def process(self, item, key=None):
@@ -94,8 +113,18 @@ class _OperatorActor:
             else:
                 self._state[key] = item
             self._emit((key, self._state[key]), key)
+        elif self.kind == "window":
+            # Count-based tumbling window: buffer `window_size` items
+            # per key, emit one aggregate per full window.
+            buf = self._windows.setdefault(key, [])
+            buf.append(item)
+            if len(buf) >= self._window_size:
+                self._windows[key] = []
+                out = self.fn(buf) if self.fn else buf
+                self._emit((key, out) if key is not None else out, key)
         elif self.kind == "sink":
             self._sink.append(self.fn(item) if self.fn else item)
+        self._since_ckpt += 1
         return None
 
     def _emit(self, item, key):
@@ -132,6 +161,49 @@ class _OperatorActor:
 
     def reduce_state(self):
         return dict(self._state)
+
+    # -- Checkpointable (actor.py:186) — active iff checkpoint_dir ----
+    def should_checkpoint(self, checkpoint_context):
+        if self._ckpt_dir is None \
+                or self._since_ckpt < self._ckpt_interval:
+            return False
+        self._since_ckpt = 0
+        return True
+
+    def save_checkpoint(self, actor_id, checkpoint_id):
+        import os
+        import pickle
+        os.makedirs(self._ckpt_dir, exist_ok=True)
+        path = os.path.join(self._ckpt_dir, checkpoint_id)
+        with open(path + ".tmp", "wb") as f:
+            pickle.dump({"state": self._state, "sink": self._sink,
+                         "windows": self._windows}, f)
+        os.replace(path + ".tmp", path)
+
+    def load_checkpoint(self, actor_id, available_checkpoints):
+        import os
+        import pickle
+        if self._ckpt_dir is None:
+            return None
+        for cp in available_checkpoints:  # newest first
+            path = os.path.join(self._ckpt_dir, cp.checkpoint_id)
+            if os.path.exists(path):
+                with open(path, "rb") as f:
+                    data = pickle.load(f)
+                self._state = data["state"]
+                self._sink = data["sink"]
+                self._windows = data.get("windows", {})
+                return cp.checkpoint_id
+        return None
+
+    def checkpoint_expired(self, actor_id, checkpoint_id):
+        import os
+        if self._ckpt_dir is None:
+            return
+        try:
+            os.unlink(os.path.join(self._ckpt_dir, checkpoint_id))
+        except FileNotFoundError:
+            pass
 
 
 def _drain_oldest(handle, inflight: deque,
@@ -179,17 +251,21 @@ def push_with_credits(handle, inflight: deque, credits: int,
 
 def flush_with_retry(handles, timeout_s: float = 30.0):
     """Barrier over possibly-restarting downstream actors: a flush that
-    dies mid-restart is retried until the actor returns or the budget
-    is exhausted."""
-    deadline = time.monotonic() + timeout_s
+    dies mid-restart is retried until the actor returns or the
+    redelivery budget is exhausted. The get is UNBOUNDED — a slow flush
+    through a backpressured pipeline is not a failure (same contract as
+    `_drain_oldest`); `timeout_s` only limits death-retrying."""
+    deadline = None
     pending = list(handles)
     while pending:
         try:
-            ray_tpu.get([h.flush.remote() for h in pending],
-                        timeout=timeout_s)
+            ray_tpu.get([h.flush.remote() for h in pending])
             return
         except (ActorDiedError, ActorUnavailableError):
-            if time.monotonic() > deadline:
+            now = time.monotonic()
+            if deadline is None:
+                deadline = now + timeout_s
+            elif now > deadline:
                 raise
             time.sleep(0.2)
 
@@ -218,6 +294,14 @@ class DataStream:
 
     def reduce(self, fn, parallelism: int = 1):
         return self._with("reduce", fn, parallelism)
+
+    def window_count(self, size: int, agg_fn: Optional[Callable] = None,
+                     parallelism: int = 1):
+        """Count-based tumbling window: every `size` items (per key
+        after a key_by) emit `agg_fn(items)` (default: the item list)."""
+        stream = self._with("window", agg_fn, parallelism)
+        stream._stages[-1]["window_size"] = int(size)
+        return stream
 
     def sum(self, parallelism: int = 1):
         return self.reduce(lambda a, b: a + b, parallelism)
@@ -273,7 +357,9 @@ class ExecutionGraph:
 
 class StreamingContext:
     def __init__(self, credits: int = None,
-                 max_operator_restarts: int = None):
+                 max_operator_restarts: int = None,
+                 checkpoint_dir: str = None,
+                 checkpoint_interval: int = 100):
         restarts = (max_operator_restarts
                     if max_operator_restarts is not None
                     else _config.get(
@@ -282,6 +368,8 @@ class StreamingContext:
             max_restarts=restarts)
         self._credits = max(1, credits if credits is not None
                             else _default_credits())
+        self._checkpoint_dir = checkpoint_dir
+        self._checkpoint_interval = checkpoint_interval
 
     def from_collection(self, items) -> DataStream:
         self._items = list(items)
@@ -289,15 +377,24 @@ class StreamingContext:
 
     def _execute(self, stages: List[dict]) -> ExecutionGraph:
         import cloudpickle
+        import os
         # Build actor stages back-to-front so each knows its downstream.
         stage_actors: List[List] = []
         downstream: List = []
-        for spec in reversed(stages):
+        for si, spec in zip(reversed(range(len(stages))),
+                            reversed(stages)):
             fn_bytes = cloudpickle.dumps(spec["fn"]) if spec["fn"] \
                 else None
+            ckpt = None
+            if self._checkpoint_dir is not None:
+                ckpt = os.path.join(self._checkpoint_dir, f"stage{si}")
             actors = [
                 self._cls.remote(spec["kind"], fn_bytes, downstream, i,
-                                 self._credits)
+                                 self._credits,
+                                 checkpoint_dir=ckpt,
+                                 checkpoint_interval=(
+                                     self._checkpoint_interval),
+                                 window_size=spec.get("window_size", 0))
                 for i in range(max(1, spec["parallelism"]))]
             stage_actors.insert(0, actors)
             downstream = actors
