@@ -276,13 +276,15 @@ def bench_sebulba(n_dev: int, env: str, obs_delta, n_actors: int,
                 out[k] = out.get(k, 0) + v
         return out
 
+    last_result = [None]
+
     def window():
         t0 = time.perf_counter()
         trained0 = opt.num_steps_trained
         s0 = transfer_totals()
         g0 = opt.learner.grad_timer.total
         while time.perf_counter() < t0 + 10:
-            trainer.train()
+            last_result[0] = trainer.train()
         dt = time.perf_counter() - t0
         trained = opt.num_steps_trained - trained0
         s1 = transfer_totals()
@@ -304,6 +306,11 @@ def bench_sebulba(n_dev: int, env: str, obs_delta, n_actors: int,
         return trained / dt / n_dev, acct
 
     med, stddev_pct, acct, rates = median_windows(window, windows)
+    reward = (last_result[0] or {}).get("episode_reward_mean")
+    # NaN -> None keeps the JSON machine-readable.
+    acct["episode_reward_mean"] = (
+        None if reward is None or reward != reward
+        else round(float(reward), 1))
     trainer.stop()  # quiesce actor uploads BEFORE timing the raw link
     link_mbps = measure_link_bandwidth_mbps()
     acct["link_mbps_raw_single_stream"] = round(link_mbps, 2)
@@ -324,7 +331,7 @@ def main():
     # Atari-statistics env (encoding + env disclosed below).
     sebulba, seb_sd, acct = bench_sebulba(
         n_dev, env="SpriteAtari-v0", obs_delta="auto",
-        n_actors=12, n_envs=256, frag=25)
+        n_actors=12, n_envs=384, frag=25)
     # Continuity line: full frames on the incompressible r3/r4 env.
     seb_full, seb_full_sd, acct_full = bench_sebulba(
         n_dev, env="SyntheticAtariFrames-v0", obs_delta=False,

@@ -2,36 +2,41 @@
 
 Parity: `streaming/python/streaming.py` (`ExecutionGraph`, operators,
 actor channels over the C++ data plane N27) — the API surface
-(StreamingContext -> source -> map/flat_map/filter/key_by/reduce/sink)
-compiles to a chain of operator actors connected by ordered actor calls
-(the framework's actor streams ARE the channel layer: per-caller
-sequence numbers give the same ordered-delivery guarantee the
-reference's ring-buffer channels provide). key_by hash-partitions items
-across the downstream operator's parallel instances.
+(StreamingContext -> source -> map/flat_map/filter/key_by/window/
+reduce/sink) compiles to a chain of operator actors connected by
+ordered actor calls (the framework's actor streams ARE the channel
+layer: per-caller sequence numbers give the same ordered-delivery
+guarantee the reference's ring-buffer channels provide). key_by
+hash-partitions items across the downstream operator's parallel
+instances.
 
 Flow control (parity: the bounded ring buffers of
 `streaming/src/ring_buffer.cc` + `data_writer.cc` backpressure): every
-edge carries at most `credits` unprocessed items. Each sender retains
-(ref, item, key) for its pushes per downstream instance; at the credit
-limit it blocks on the OLDEST ref (ordered actor streams complete
-in order) before pushing more, so a fast source stalls against a slow
-sink instead of growing an unbounded queue — back-pressure propagates
-hop by hop up to the driver's source loop.
+edge carries at most `credits` UNACKED items. At the credit limit the
+sender blocks on the OLDEST outstanding push (ordered actor streams
+complete in order) before pushing more, so a fast source stalls
+against a slow sink instead of growing an unbounded queue —
+back-pressure propagates hop by hop up to the driver's source loop.
 
 Failure recovery (parity: `streaming/src/data_writer.cc` channel
-recreation on reader/writer restart): operator actors run with
-`max_restarts` (default `RAY_TPU_STREAMING_OPERATOR_RESTARTS`); the
-sender's credit window doubles as the redelivery buffer. When a
-drain observes the downstream instance died, the sender REPLAYS every
-undrained in-flight item, in order, against the restarted actor —
-**at-least-once** delivery: an item whose `process` completed on the
-dead instance just before the crash is replayed and may be processed
-twice (exactly the reference data plane's contract; make sinks/
-reducers idempotent or key results if that matters). Operator STATE
-(`reduce` accumulators, sink buffers) restarts empty — state
-persistence is the application's job, same as the reference's. A
+recreation on reader/writer restart; the checkpoint-coverage idea is
+the classic upstream-backup protocol): operator actors run with
+`max_restarts`; every edge's items carry per-edge SEQUENCE NUMBERS,
+and each sender retains items until the downstream's CHECKPOINT covers
+them (the downstream reports its checkpoint-covered seq in every ack).
+When a drain observes the downstream died, the sender replays every
+retained item — retired-but-uncovered first, then the unacked window —
+in order, against the restarted actor. The receiver dedups by seq
+against its restored state. Net guarantee WITH a `checkpoint_dir`:
+**effectively-once** per edge into operator state for deterministic
+operators (replays reconstruct exactly the uncheckpointed suffix; no
+loss, no double-apply). Without a checkpoint_dir, state restarts EMPTY
+and replay covers retained items only — at-least-once delivery of the
+recent window, the reference data plane's contract. Nondeterministic
+operator fns weaken replay reconstruction to at-least-once. A
 downstream that exhausts its restart budget fails the pipeline with
-the underlying `ActorDiedError`.
+the underlying `ActorDiedError`. Sender retention is bounded by
+`checkpoint_interval` + `credits` items per edge.
 """
 
 from __future__ import annotations
@@ -57,18 +62,101 @@ def _stable_hash(key) -> int:
         hashlib.md5(repr(key).encode()).digest()[:8], "little")
 
 
+class EdgeSender:
+    """Sender half of one channel edge (module doc: flow control +
+    upstream-backup recovery).
+
+    - `inflight`: pushed, unacked (ref, item, key, seq) — the credit
+      window.
+    - `retired`: acked but not yet covered by the downstream's
+      checkpoint — kept for replay after a downstream restart, trimmed
+      as acks report growing coverage.
+    - `seq`: per-edge monotone counter; the receiver dedups on it.
+    """
+
+    def __init__(self, handle, edge_id: str, credits: int,
+                 start_seq: int = 0):
+        self.handle = handle
+        self.edge_id = edge_id
+        self.credits = max(1, credits)
+        self.seq = start_seq
+        self.inflight: deque = deque()  # (ref, item, key, seq)
+        self.retired: deque = deque()   # (item, key, seq)
+        self.covered = 0
+
+    def push(self, item, key=None) -> None:
+        while len(self.inflight) >= self.credits:
+            self.drain_oldest()
+        self.seq += 1
+        self.inflight.append(
+            (self.handle.process.remote(item, key, self.seq,
+                                        self.edge_id),
+             item, key, self.seq))
+
+    def _trim_retired(self) -> None:
+        while self.retired and self.retired[0][2] <= self.covered:
+            self.retired.popleft()
+
+    def drain_oldest(self, redeliver_timeout_s: float = 30.0) -> None:
+        """Complete the oldest unacked push; on downstream death,
+        replay everything retained (module doc), retrying until the
+        actor comes back or the redelivery budget is exhausted. The
+        get itself is UNBOUNDED — a slow-but-alive downstream is
+        backpressure, not failure; only an observed death starts the
+        redelivery clock."""
+        deadline = None
+        while True:
+            ref, item, key, seq = self.inflight[0]
+            try:
+                ack = ray_tpu.get(ref)
+                self.inflight.popleft()
+                self.retired.append((item, key, seq))
+                if isinstance(ack, int):
+                    self.covered = max(self.covered, ack)
+                self._trim_retired()
+                return
+            except (ActorDiedError, ActorUnavailableError):
+                now = time.monotonic()
+                if deadline is None:
+                    deadline = now + redeliver_timeout_s
+                elif now > deadline:
+                    raise
+                time.sleep(0.2)
+                self._replay()
+            # Task-level errors (user fn raised) are not delivery
+            # failures; they propagate out of the get above.
+
+    def _replay(self) -> None:
+        """Re-push everything the downstream's checkpoint does not
+        cover, in seq order (the receiver dedups anything it has
+        already applied post-restore)."""
+        items = [(item, key, seq) for item, key, seq in self.retired
+                 if seq > self.covered]
+        items += [(item, key, seq) for _, item, key, seq
+                  in self.inflight]
+        self.retired = deque(
+            (i, k, s) for i, k, s in self.retired if s <= self.covered)
+        self.inflight = deque(
+            (self.handle.process.remote(item, key, seq, self.edge_id),
+             item, key, seq) for item, key, seq in items)
+
+    def drain_all(self) -> None:
+        while self.inflight:
+            self.drain_oldest()
+
+
 class _OperatorActor:
     """One parallel instance of one operator stage.
 
     With a `checkpoint_dir`, operator STATE (reduce accumulators,
-    window buffers, sink values) survives actor restarts through the
-    framework's `Checkpointable` protocol (`actor.py:186`): the
-    runtime checkpoints every `checkpoint_interval` processed items
-    and restores the newest checkpoint after a restart — so a killed
-    reduce resumes its accumulators instead of restarting empty, and
-    the sender's at-least-once replay (module doc) only re-applies the
-    post-checkpoint tail. Without a checkpoint_dir the protocol is
-    dormant (`should_checkpoint` False) and state restarts empty.
+    window buffers, sink values, per-edge applied seqs, downstream
+    emit seqs) survives actor restarts through the framework's
+    `Checkpointable` protocol (`actor.py:186`); combined with the
+    senders' checkpoint-coverage retention this yields the
+    effectively-once contract in the module doc. Without a
+    checkpoint_dir the protocol is dormant (`should_checkpoint`
+    False), acks report applied seqs directly (senders retain nothing
+    beyond the credit window), and state restarts empty.
     """
 
     def __init__(self, kind: str, fn_bytes, downstream_handles,
@@ -83,20 +171,30 @@ class _OperatorActor:
         self.instance_id = instance_id
         self.credits = max(1, credits if credits is not None
                            else _default_credits())
-        # Per-downstream-edge in-flight push refs (the credit window).
-        self._inflight: List[deque] = [deque()
-                                       for _ in downstream_handles]
+        self._senders = [
+            EdgeSender(h, f"{kind}{instance_id}->d{i}", self.credits)
+            for i, h in enumerate(downstream_handles)]
         self._state: Dict[Any, Any] = {}  # key -> accumulated value
         self._windows: Dict[Any, list] = {}  # key -> buffered items
         self._window_size = int(window_size)
         self._sink: List[Any] = []
         self._rr = 0
+        # Per-upstream-edge seq bookkeeping (module doc).
+        self._edge_seq: Dict[str, int] = {}       # last APPLIED
+        self._ckpt_edge_seq: Dict[str, int] = {}  # covered by last ckpt
         self._ckpt_dir = checkpoint_dir
         self._ckpt_interval = max(1, int(checkpoint_interval))
         self._since_ckpt = 0
 
     # -- data plane ------------------------------------------------------
-    def process(self, item, key=None):
+    def process(self, item, key=None, seq=None, edge=None):
+        """Apply one item; returns this edge's checkpoint-covered seq
+        (the sender's retention watermark). Duplicate seqs (replays of
+        already-applied items) are skipped but still acked."""
+        if edge is not None and seq is not None:
+            if seq <= self._edge_seq.get(edge, 0):
+                return self._ack(edge)
+            self._edge_seq[edge] = seq
         if self.kind == "map":
             self._emit(self.fn(item), key)
         elif self.kind == "flat_map":
@@ -125,20 +223,29 @@ class _OperatorActor:
         elif self.kind == "sink":
             self._sink.append(self.fn(item) if self.fn else item)
         self._since_ckpt += 1
-        return None
+        return self._ack(edge)
+
+    def _ack(self, edge):
+        """Checkpointing ON: the sender may retire an item only once a
+        checkpoint covers it. OFF: applied == covered (no retention —
+        plain at-least-once of the credit window)."""
+        if edge is None:
+            return 0
+        if self._ckpt_dir is None:
+            return self._edge_seq.get(edge, 0)
+        return self._ckpt_edge_seq.get(edge, 0)
 
     def _emit(self, item, key):
-        if not self.downstream:
+        if not self._senders:
             return
         if key is not None:
             # Stable cross-process hash: Python's hash() is salted per
             # process, which would scatter one key over partitions.
-            i = _stable_hash(key) % len(self.downstream)
+            i = _stable_hash(key) % len(self._senders)
         else:
             i = self._rr
-            self._rr = (self._rr + 1) % len(self.downstream)
-        push_with_credits(self.downstream[i], self._inflight[i],
-                          self.credits, item, key)
+            self._rr = (self._rr + 1) % len(self._senders)
+        self._senders[i].push(item, key)
 
     # -- control ---------------------------------------------------------
     def flush(self):
@@ -149,9 +256,8 @@ class _OperatorActor:
         propagated (the reference's channel flush semantics). Drains
         this instance's own credit windows first so a downstream death
         replays them before the barrier passes."""
-        for handle, inflight in zip(self.downstream, self._inflight):
-            while inflight:
-                _drain_oldest(handle, inflight)
+        for s in self._senders:
+            s.drain_all()
         if self.downstream:
             flush_with_retry(self.downstream)
         return "ok"
@@ -176,9 +282,27 @@ class _OperatorActor:
         os.makedirs(self._ckpt_dir, exist_ok=True)
         path = os.path.join(self._ckpt_dir, checkpoint_id)
         with open(path + ".tmp", "wb") as f:
-            pickle.dump({"state": self._state, "sink": self._sink,
-                         "windows": self._windows}, f)
+            pickle.dump({
+                "state": self._state, "sink": self._sink,
+                "windows": self._windows, "rr": self._rr,
+                "edge_seq": dict(self._edge_seq),
+                # The senders' outgoing retention IS state: coverage of
+                # this checkpoint will let the UPSTREAM trim its own
+                # retention of our inputs, so outputs not yet covered
+                # downstream must be durable HERE or a crash drops them
+                # (review finding r5: mid-pipeline loss).
+                "senders": [{
+                    "seq": s.seq,
+                    "covered": s.covered,
+                    "retired": list(s.retired),
+                    "inflight": [(item, key, seq) for _, item, key, seq
+                                 in s.inflight],
+                } for s in self._senders],
+            }, f)
         os.replace(path + ".tmp", path)
+        # Only NOW is this state durable: advance the coverage acks
+        # report (upstream retention trims against it).
+        self._ckpt_edge_seq = dict(self._edge_seq)
 
     def load_checkpoint(self, actor_id, available_checkpoints):
         import os
@@ -193,6 +317,22 @@ class _OperatorActor:
                 self._state = data["state"]
                 self._sink = data["sink"]
                 self._windows = data.get("windows", {})
+                self._rr = data.get("rr", 0)
+                self._edge_seq = dict(data.get("edge_seq", {}))
+                self._ckpt_edge_seq = dict(self._edge_seq)
+                for s, saved in zip(self._senders,
+                                    data.get("senders", [])):
+                    s.seq = saved["seq"]
+                    s.covered = saved["covered"]
+                    s.retired = deque(saved["retired"])
+                    # Pushes that were UNACKED at checkpoint time died
+                    # with the old process; re-push them now (the
+                    # downstream dedups any it already applied).
+                    s.inflight = deque(
+                        (s.handle.process.remote(item, key, seq,
+                                                 s.edge_id),
+                         item, key, seq)
+                        for item, key, seq in saved["inflight"])
                 return cp.checkpoint_id
         return None
 
@@ -206,55 +346,12 @@ class _OperatorActor:
             pass
 
 
-def _drain_oldest(handle, inflight: deque,
-                  redeliver_timeout_s: float = 30.0):
-    """Complete the oldest in-flight push; on downstream death, replay
-    every undrained item (module doc: at-least-once) against the
-    restarted actor, retrying until it comes back or the redelivery
-    budget is exhausted. The get itself is UNBOUNDED — a slow-but-alive
-    downstream is backpressure, not failure (the documented stall
-    contract); only an observed actor death starts the redelivery
-    clock."""
-    deadline = None
-    while True:
-        ref, item, key = inflight[0]
-        try:
-            ray_tpu.get(ref)
-            inflight.popleft()
-            return
-        except (ActorDiedError, ActorUnavailableError):
-            now = time.monotonic()
-            if deadline is None:
-                deadline = now + redeliver_timeout_s
-            elif now > deadline:
-                raise
-            # Redeliver the whole undrained window in order.
-            time.sleep(0.2)
-            replay = [(handle.process.remote(it, k), it, k)
-                      for _, it, k in inflight]
-            inflight.clear()
-            inflight.extend(replay)
-        # Task-level errors (user fn raised) are not delivery
-        # failures; they propagate out of the get above.
-
-
-def push_with_credits(handle, inflight: deque, credits: int,
-                      item, key=None):
-    """Ordered push bounded by the edge's credit window: at the limit,
-    block on the oldest outstanding push (completes first — actor
-    streams are ordered) before issuing the next. The window entries
-    retain (ref, item, key) so a downstream death can replay them."""
-    while len(inflight) >= credits:
-        _drain_oldest(handle, inflight)
-    inflight.append((handle.process.remote(item, key), item, key))
-
-
 def flush_with_retry(handles, timeout_s: float = 30.0):
     """Barrier over possibly-restarting downstream actors: a flush that
     dies mid-restart is retried until the actor returns or the
     redelivery budget is exhausted. The get is UNBOUNDED — a slow flush
     through a backpressured pipeline is not a failure (same contract as
-    `_drain_oldest`); `timeout_s` only limits death-retrying."""
+    `EdgeSender.drain_oldest`); `timeout_s` only limits death-retrying."""
     deadline = None
     pending = list(handles)
     while pending:
@@ -322,22 +419,25 @@ class ExecutionGraph:
         self._source_items = source_items
         self._credits = max(1, credits if credits is not None
                             else _default_credits())
+        # Source senders persist across run() calls: edge seqs must
+        # keep increasing or a second run()'s items would dedup away
+        # as replays (review finding r5).
+        self._source_senders = [
+            EdgeSender(a, f"src->s{j}", self._credits)
+            for j, a in enumerate(self.stage_actors[0])]
 
     def run(self):
         """Push every source item through, then flush the DAG. The
         source loop itself respects the credit window: a slow sink
         stalls THIS loop, not an unbounded in-cluster queue. A stage
         instance dying mid-run is redelivered to after restart
-        (module doc: at-least-once)."""
+        (module doc). Calling run() again re-pushes the source items
+        as NEW occurrences (fresh seqs)."""
         first = self.stage_actors[0]
-        inflight = [deque() for _ in first]
         for i, item in enumerate(self._source_items):
-            j = i % len(first)
-            push_with_credits(first[j], inflight[j], self._credits,
-                              item)
-        for j, a in enumerate(first):
-            while inflight[j]:
-                _drain_oldest(a, inflight[j])
+            self._source_senders[i % len(first)].push(item)
+        for s in self._source_senders:
+            s.drain_all()
         flush_with_retry(first)
         return self
 

@@ -88,18 +88,22 @@ class TestLeases:
 
     def test_slow_tasks_keep_shallow_pipelines(self, ray_session):
         """Slow tasks must not pile onto one lease (adaptive depth):
-        with 2 CPUs, 4 x 0.5s tasks should run 2-wide, well under the
-        4 x 0.5s serial floor."""
+        with 2 CPUs, 6 x 0.5s tasks should run 2-wide, well under the
+        6 x 0.5s serial floor. (Six tasks, not four: the wider gap
+        between the 1.5s overlapped and 3.0s serial floors tolerates
+        this 1-core CI box's load-induced wakeup delays without the
+        threshold creeping past the serial floor.)"""
         @ray_tpu.remote
         def slow():
             time.sleep(0.5)
             return os.getpid()
 
         t0 = time.monotonic()
-        pids = ray_tpu.get([slow.remote() for _ in range(4)], timeout=60)
+        pids = ray_tpu.get([slow.remote() for _ in range(6)], timeout=60)
         took = time.monotonic() - t0
         assert len(set(pids)) >= 2, "no parallelism across leases"
-        assert took < 1.9, f"serialized onto one lease: {took:.1f}s"
+        # Overlapped 2-wide: ~1.5-1.9s. Serial floor: 3.0s.
+        assert took < 2.7, f"serialized onto one lease: {took:.1f}s"
 
     def test_disable_leases_env(self, monkeypatch):
         monkeypatch.setenv("RAY_TPU_DISABLE_LEASES", "1")
