@@ -1,0 +1,75 @@
+"""Exported-policy serving artifacts.
+
+Parity: `rllib/policy/policy.py:280` `export_model` — the reference
+exports TF policies as SavedModels for serving outside RLlib
+(`tf_policy.py:389`). The XLA-native equivalent is a serialized
+StableHLO program (`jax.export`): the policy's deterministic inference
+function compiles once, serializes portably, and reloads WITHOUT the
+policy class, model catalog, or any framework code — only jax and the
+saved weights.
+
+Layout of an export directory (written by `JaxPolicy.export_model`):
+
+    inference.stablehlo   serialized (params, obs) -> (actions,
+                          dist_inputs, value) program
+    params.pkl            host-side weight pytree
+    meta.json             spaces + shapes for validation
+
+`load_exported_policy(path)` returns a callable object with
+`compute_actions(obs_batch)` — enough to drive `serve` backends or an
+external scorer.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import numpy as np
+
+
+class ExportedPolicy:
+    """A reloaded export: framework-free greedy inference."""
+
+    def __init__(self, path: str):
+        from jax import export as jax_export
+        with open(os.path.join(path, "inference.stablehlo"), "rb") as f:
+            self._exported = jax_export.deserialize(f.read())
+        with open(os.path.join(path, "params.pkl"), "rb") as f:
+            self._params = pickle.load(f)
+        with open(os.path.join(path, "meta.json")) as f:
+            self.meta = json.load(f)
+
+    def compute_actions(self, obs_batch):
+        obs = np.asarray(obs_batch)
+        expect = tuple(self.meta["obs_shape"])
+        if tuple(obs.shape[1:]) != expect:
+            raise ValueError(
+                f"obs batch shape {obs.shape[1:]} != exported "
+                f"{expect}")
+        want = np.dtype(self.meta["obs_dtype"])
+        if obs.dtype != want:
+            # Same-kind widening is fine (float32->float32 etc.); a
+            # kind change (float frames into a uint8 program) would
+            # silently corrupt the pixels — refuse it.
+            if not np.can_cast(obs.dtype, want, casting="same_kind"):
+                raise ValueError(
+                    f"obs dtype {obs.dtype} cannot safely serve the "
+                    f"exported {want} program; convert explicitly")
+            obs = obs.astype(want)
+        if obs.shape[0] == 0:
+            a = int(np.prod(
+                getattr(self._exported.out_avals[1], "shape",
+                        (0, 0))[-1:]))
+            return (np.empty((0,), np.int64),
+                    np.empty((0, a), np.float32),
+                    np.empty((0,), np.float32))
+        actions, dist_inputs, value = self._exported.call(
+            self._params, obs)
+        return (np.asarray(actions), np.asarray(dist_inputs),
+                np.asarray(value))
+
+
+def load_exported_policy(path: str) -> ExportedPolicy:
+    return ExportedPolicy(path)

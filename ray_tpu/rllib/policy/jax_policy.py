@@ -536,3 +536,52 @@ class JaxPolicy(Policy):
     def num_params(self) -> int:
         return sum(int(np.prod(p.shape))
                    for p in jax.tree.leaves(self.params))
+
+    def export_model(self, export_dir: str) -> str:
+        """Serialize deterministic inference as a STANDALONE artifact
+        (parity: `rllib/policy/policy.py:280` export_model / the TF
+        SavedModel export at `tf_policy.py:389`): a StableHLO program
+        via `jax.export` plus host weights — reloadable with
+        `policy/export.py:load_exported_policy` and NO framework code.
+        The batch dimension exports SYMBOLICALLY (any batch size at
+        serving time, no padding waste) and the program targets both
+        cpu and tpu, so a TPU-trained policy serves from CPU hosts.
+        Feedforward policies only (recurrent export needs carried
+        state; same scoping as the reference's torch export)."""
+        import json
+        import os
+        import pickle
+
+        from jax import export as jax_export
+        if self.recurrent:
+            raise NotImplementedError(
+                "export_model supports feedforward policies only")
+        obs_shape = tuple(self.preprocessor.shape)
+        obs_dtype = np.dtype(self.preprocessor.dtype)
+
+        def infer(params, obs):
+            dist_inputs, value = self.apply(params, obs)
+            dist = self.dist_class(dist_inputs)
+            return dist.deterministic_sample(), dist_inputs, value
+
+        host_params = self.get_weights()
+        batch = jax_export.symbolic_shape("b")[0]
+        exported = jax_export.export(
+            jax.jit(infer), platforms=("cpu", "tpu"))(
+            jax.tree.map(
+                lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                host_params),
+            jax.ShapeDtypeStruct((batch,) + obs_shape, obs_dtype))
+        os.makedirs(export_dir, exist_ok=True)
+        with open(os.path.join(export_dir,
+                               "inference.stablehlo"), "wb") as f:
+            f.write(exported.serialize())
+        with open(os.path.join(export_dir, "params.pkl"), "wb") as f:
+            pickle.dump(host_params, f)
+        with open(os.path.join(export_dir, "meta.json"), "w") as f:
+            json.dump({
+                "obs_shape": list(obs_shape),
+                "obs_dtype": obs_dtype.name,
+                "action_space": repr(self.action_space),
+            }, f)
+        return export_dir

@@ -1,0 +1,100 @@
+"""Policy export (parity: `rllib/policy/policy.py:280` export_model):
+StableHLO + weights artifacts reloadable without framework code."""
+
+import numpy as np
+import pytest
+
+
+def _make_policy():
+    from ray_tpu.rllib.agents.pg.pg import DEFAULT_CONFIG, PGJaxPolicy
+    from ray_tpu.rllib.env.spaces import Box, Discrete
+    cfg = dict(DEFAULT_CONFIG)
+    cfg.update({"model": {"fcnet_hiddens": [16]}, "seed": 0})
+    return PGJaxPolicy(
+        Box(low=-1, high=1, shape=(4,), dtype=np.float32),
+        Discrete(2), cfg)
+
+
+class TestExportModel:
+    def test_roundtrip_matches_policy(self, tmp_path):
+        from ray_tpu.rllib.policy.export import load_exported_policy
+        policy = _make_policy()
+        path = policy.export_model(str(tmp_path / "exp"))
+        loaded = load_exported_policy(path)
+        obs = np.random.default_rng(0).uniform(
+            -1, 1, size=(5, 4)).astype(np.float32)
+        acts, dist_inputs, value = loaded.compute_actions(obs)
+        # Must match the live policy's deterministic actions.
+        ref_acts, _, extra = policy.compute_actions(obs, explore=False)
+        np.testing.assert_array_equal(acts, ref_acts)
+        np.testing.assert_allclose(
+            dist_inputs, extra["action_dist_inputs"], rtol=1e-5)
+        assert value.shape == (5,)
+
+    def test_symbolic_batch_and_validation(self, tmp_path):
+        from ray_tpu.rllib.policy.export import load_exported_policy
+        policy = _make_policy()
+        loaded = load_exported_policy(
+            policy.export_model(str(tmp_path / "e2")))
+        # The batch dim is symbolic: any size serves without padding.
+        for n in (1, 4, 9):
+            acts, _, _ = loaded.compute_actions(
+                np.zeros((n, 4), np.float32))
+            assert acts.shape == (n,)
+        # Empty batches return empty results, not an XLA shape error.
+        acts, di, val = loaded.compute_actions(
+            np.zeros((0, 4), np.float32))
+        assert acts.shape == (0,) and val.shape == (0,)
+        with pytest.raises(ValueError, match="shape"):
+            loaded.compute_actions(np.zeros((2, 3), np.float32))
+
+    def test_unsafe_dtype_refused(self, tmp_path):
+        """Float frames into a uint8-exported program would silently
+        truncate to garbage; the loader must refuse the cast."""
+        from ray_tpu.rllib.agents.pg.pg import DEFAULT_CONFIG, PGJaxPolicy
+        from ray_tpu.rllib.env.spaces import Box, Discrete
+        from ray_tpu.rllib.policy.export import load_exported_policy
+        cfg = dict(DEFAULT_CONFIG)
+        cfg.update({"model": {"fcnet_hiddens": [8],
+                              "conv_filters": ((4, 8, 4), (8, 4, 2))},
+                    "seed": 0})
+        policy = PGJaxPolicy(
+            Box(low=0, high=255, shape=(84, 84, 1), dtype=np.uint8),
+            Discrete(4), cfg)
+        loaded = load_exported_policy(
+            policy.export_model(str(tmp_path / "e4")))
+        with pytest.raises(ValueError, match="dtype"):
+            loaded.compute_actions(
+                np.zeros((1, 84, 84, 1), np.float32))
+
+    def test_recurrent_export_rejected(self):
+        from ray_tpu.rllib.agents.pg.pg import DEFAULT_CONFIG, PGJaxPolicy
+        from ray_tpu.rllib.env.spaces import Box, Discrete
+        cfg = dict(DEFAULT_CONFIG)
+        cfg.update({"model": {"use_lstm": True,
+                              "fcnet_hiddens": [8]}, "seed": 0})
+        pol = PGJaxPolicy(
+            Box(low=-1, high=1, shape=(4,), dtype=np.float32),
+            Discrete(2), cfg)
+        with pytest.raises(NotImplementedError):
+            pol.export_model("/tmp/unused")
+
+    def test_atari_shaped_export(self, tmp_path):
+        """uint8 conv policies export too (the serving shape)."""
+        from ray_tpu.rllib.agents.pg.pg import DEFAULT_CONFIG, PGJaxPolicy
+        from ray_tpu.rllib.env.spaces import Box, Discrete
+        from ray_tpu.rllib.policy.export import load_exported_policy
+        cfg = dict(DEFAULT_CONFIG)
+        cfg.update({"model": {"fcnet_hiddens": [8],
+                              "conv_filters": ((4, 8, 4), (8, 4, 2))},
+                    "seed": 0})
+        policy = PGJaxPolicy(
+            Box(low=0, high=255, shape=(84, 84, 1), dtype=np.uint8),
+            Discrete(4), cfg)
+        loaded = load_exported_policy(
+            policy.export_model(str(tmp_path / "e3")))
+        obs = np.random.default_rng(1).integers(
+            0, 255, size=(2, 84, 84, 1), dtype=np.uint8)
+        acts, _, _ = loaded.compute_actions(obs)
+        ref, _, _ = policy.compute_actions(obs, explore=False)
+        np.testing.assert_array_equal(acts, ref)
