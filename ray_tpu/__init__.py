@@ -204,6 +204,24 @@ def timeline(filename: _Optional[str] = None):
     return _prof.chrome_trace(events)
 
 
+def xla_profile(logdir: str):
+    """Capture THIS process's device-side XLA trace (compiled program
+    execution, HBM transfers, fusion timing) into a TensorBoard/
+    Perfetto-loadable profile directory — the device-level complement
+    to `timeline()`'s host-span view (SURVEY.md §5.1: the runtime
+    timeline + XLA profiler integration). Run it around the hot loop
+    in the process that owns the device (the learner):
+
+        with ray_tpu.xla_profile("/tmp/prof"):
+            trainer.train()
+
+    View with `tensorboard --logdir /tmp/prof` (profile plugin) or
+    Perfetto on the generated .trace files.
+    """
+    import jax
+    return jax.profiler.trace(logdir)
+
+
 def cluster_resources() -> dict:
     return _ws.get_runtime().cluster_info()["total_resources"]
 
@@ -232,4 +250,5 @@ __all__ = [
     "exit_actor", "free",
     "get", "get_actor", "init", "is_initialized", "kill", "method",
     "profile", "put", "remote", "shutdown", "timeline", "wait",
+    "xla_profile",
 ]

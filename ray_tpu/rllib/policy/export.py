@@ -59,12 +59,14 @@ class ExportedPolicy:
                     f"exported {want} program; convert explicitly")
             obs = obs.astype(want)
         if obs.shape[0] == 0:
-            a = int(np.prod(
-                getattr(self._exported.out_avals[1], "shape",
-                        (0, 0))[-1:]))
-            return (np.empty((0,), np.int64),
-                    np.empty((0, a), np.float32),
-                    np.empty((0,), np.float32))
+            # Empty outputs mirror the program's own result avals
+            # (trailing dims are concrete; only the batch is symbolic),
+            # so Box and Discrete actions both come back with the
+            # exact downstream-concatenable shape/dtype.
+            return tuple(
+                np.empty((0,) + tuple(av.shape[1:]),
+                         np.dtype(av.dtype))
+                for av in self._exported.out_avals)
         actions, dist_inputs, value = self._exported.call(
             self._params, obs)
         return (np.asarray(actions), np.asarray(dist_inputs),

@@ -96,3 +96,22 @@ class TestCLI:
         finally:
             head.terminate()
             head.wait(timeout=15)
+
+
+def test_xla_profile_captures_device_trace(tmp_path):
+    """SURVEY §5.1: device-side XLA traces complement the host span
+    timeline; the context manager must produce a loadable profile."""
+    import glob
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ray_tpu
+    d = str(tmp_path / "prof")
+    with ray_tpu.xla_profile(d):
+        jax.jit(lambda x: jnp.tanh(x) @ x.T)(
+            np.ones((64, 64), np.float32)).block_until_ready()
+    found = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+    assert any(os.path.isfile(f) for f in found), found
