@@ -1,0 +1,125 @@
+"""`tune` CLI: inspect experiment directories from the shell.
+
+Parity: `python/ray/tune/scripts.py` (`tune list-trials` /
+`list-experiments`) — offline inspection of the result artifacts the
+loggers write (`result.json`, `params.json` per trial dir):
+
+    python -m ray_tpu.tune list-trials  ~/ray_tpu_results/my-exp
+    python -m ray_tpu.tune best        ~/ray_tpu_results/my-exp \
+        --metric episode_reward_mean
+    python -m ray_tpu.tune list-experiments ~/ray_tpu_results
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def _trial_rows(exp_dir: str):
+    """(trial_dir, params, last_result) per trial subdirectory."""
+    rows = []
+    for rj in sorted(glob.glob(os.path.join(exp_dir, "*",
+                                            "result.json"))):
+        tdir = os.path.dirname(rj)
+        last = None
+        with open(rj) as f:
+            for line in f:
+                if line.strip():
+                    try:
+                        last = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue  # torn tail of a live experiment
+        params = {}
+        pj = os.path.join(tdir, "params.json")
+        if os.path.exists(pj):
+            with open(pj) as f:
+                params = json.load(f)
+        rows.append((tdir, params, last or {}))
+    return rows
+
+
+def cmd_list_trials(args):
+    rows = _trial_rows(args.experiment_dir)
+    if not rows:
+        sys.exit(f"no trial results under {args.experiment_dir!r}")
+    for tdir, _params, last in rows:
+        name = os.path.basename(tdir)
+        it = last.get("training_iteration", "-")
+        rew = last.get("episode_reward_mean")
+        rew = f"{rew:.1f}" if isinstance(rew, (int, float)) \
+            and rew == rew else "-"
+        extra = ""
+        if args.metric and args.metric in last:
+            extra = f"  {args.metric}={last[args.metric]}"
+        print(f"{name:<40s} iter={it:<6} reward={rew}{extra}")
+    print(f"{len(rows)} trial(s)")
+
+
+def cmd_best(args):
+    rows = _trial_rows(args.experiment_dir)
+    if not rows:
+        sys.exit(f"no trial results under {args.experiment_dir!r}")
+    sign = 1.0 if args.mode == "max" else -1.0
+    scored = [(tdir, params, last) for tdir, params, last in rows
+              if isinstance(last.get(args.metric), (int, float))
+              and last[args.metric] == last[args.metric]]
+    if not scored:
+        sys.exit(f"no trial reported metric {args.metric!r}")
+    tdir, params, last = max(
+        scored, key=lambda r: sign * r[2][args.metric])
+    print(f"best trial: {os.path.basename(tdir)}")
+    print(f"  {args.metric} = {last[args.metric]}")
+    print(f"  iterations = {last.get('training_iteration')}")
+    print(f"  logdir = {tdir}")
+    print("  config:")
+    for k, v in sorted(params.items()):
+        print(f"    {k}: {v!r}")
+
+
+def cmd_list_experiments(args):
+    found = 0
+    for state in sorted(glob.glob(os.path.join(
+            args.project_dir, "*", "experiment_state.json"))):
+        exp_dir = os.path.dirname(state)
+        rows = _trial_rows(exp_dir)
+        done = sum(1 for _, _, last in rows
+                   if last.get("training_iteration"))
+        print(f"{os.path.basename(exp_dir):<40s} trials={len(rows)} "
+              f"reported={done}")
+        found += 1
+    if not found:
+        sys.exit(f"no experiments under {args.project_dir!r}")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="ray_tpu.tune")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser("list-trials",
+                       help="trials + last results of one experiment")
+    p.add_argument("experiment_dir")
+    p.add_argument("--metric", default=None,
+                   help="extra result column to print")
+    p.set_defaults(fn=cmd_list_trials)
+
+    p = sub.add_parser("best", help="best trial by a metric")
+    p.add_argument("experiment_dir")
+    p.add_argument("--metric", default="episode_reward_mean")
+    p.add_argument("--mode", choices=("max", "min"), default="max")
+    p.set_defaults(fn=cmd_best)
+
+    p = sub.add_parser("list-experiments",
+                       help="experiments under a results root")
+    p.add_argument("project_dir")
+    p.set_defaults(fn=cmd_list_experiments)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

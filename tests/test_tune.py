@@ -486,3 +486,49 @@ class TestDurableCheckpoints:
         t.restore_from_object(blob)
         assert t.n == 5
         t.stop()
+
+
+class TestTuneCLI:
+    """`python -m ray_tpu.tune` offline inspection (parity:
+    `python/ray/tune/scripts.py` list-trials/list-experiments)."""
+
+    def _run_small_experiment(self, tmp_path):
+        import ray_tpu
+        from ray_tpu.tune import grid_search as gs, run
+        ray_tpu.init(num_cpus=2)
+        try:
+            def trainable(config, reporter):
+                for i in range(3):
+                    reporter(
+                        episode_reward_mean=config["x"] * (i + 1),
+                        training_iteration=i + 1)
+
+            analysis = run(trainable,
+                           config={"x": gs([1, 10])},
+                           stop={"training_iteration": 3},
+                           local_dir=str(tmp_path),
+                           name="cli-exp")
+        finally:
+            ray_tpu.shutdown()
+        return analysis
+
+    def test_list_and_best(self, tmp_path, capsys):
+        self._run_small_experiment(tmp_path)
+        from ray_tpu.tune.__main__ import main
+        exp_dir = str(tmp_path / "cli-exp")
+        main(["list-trials", exp_dir])
+        out = capsys.readouterr().out
+        assert "2 trial(s)" in out and "iter=3" in out
+        main(["best", exp_dir, "--metric", "episode_reward_mean"])
+        out = capsys.readouterr().out
+        assert "episode_reward_mean = 30" in out
+        assert "x: 10" in out
+        main(["list-experiments", str(tmp_path)])
+        out = capsys.readouterr().out
+        assert "cli-exp" in out and "trials=2" in out
+
+    def test_missing_dir_errors(self, tmp_path):
+        import pytest as _pytest
+        from ray_tpu.tune.__main__ import main
+        with _pytest.raises(SystemExit):
+            main(["list-trials", str(tmp_path / "nope")])
