@@ -18,6 +18,12 @@ Hardening (VERDICT r4 next #6):
 Usage:
     python -m ray_tpu.rllib.run_regression_tests [yaml ...]
     python -m ray_tpu.rllib.run_regression_tests          # whole dir
+
+Run the classic-control yamls with JAX on CPU (JAX_PLATFORMS=cpu
+PALLAS_AXON_POOL_IPS=): their updates are tiny and per-call latency
+dominates — through a remote/tunneled accelerator a CartPole DQN
+iteration is ~50x slower than local CPU. The Atari-scale yamls are the
+ones that belong on real chips.
 """
 
 from __future__ import annotations

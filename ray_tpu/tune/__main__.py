@@ -36,8 +36,11 @@ def _trial_rows(exp_dir: str):
         params = {}
         pj = os.path.join(tdir, "params.json")
         if os.path.exists(pj):
-            with open(pj) as f:
-                params = json.load(f)
+            try:
+                with open(pj) as f:
+                    params = json.load(f)
+            except json.JSONDecodeError:
+                pass  # torn write: list the trial without its config
         rows.append((tdir, params, last or {}))
     return rows
 
@@ -85,10 +88,23 @@ def cmd_list_experiments(args):
     for state in sorted(glob.glob(os.path.join(
             args.project_dir, "*", "experiment_state.json"))):
         exp_dir = os.path.dirname(state)
-        rows = _trial_rows(exp_dir)
-        done = sum(1 for _, _, last in rows
-                   if last.get("training_iteration"))
-        print(f"{os.path.basename(exp_dir):<40s} trials={len(rows)} "
+        # One O(1) read per experiment: the runner's own snapshot
+        # already carries per-trial last results (trial_runner.py
+        # checkpoint_experiment) — no need to scan every result.json.
+        try:
+            with open(state) as f:
+                snap = json.load(f)
+            trials = snap.get("trials", [])
+            done = sum(1 for t in trials
+                       if (t.get("last_result") or {}).get(
+                           "training_iteration"))
+            n = len(trials)
+        except (json.JSONDecodeError, OSError):
+            rows = _trial_rows(exp_dir)  # torn snapshot: slow path
+            n = len(rows)
+            done = sum(1 for _, _, last in rows
+                       if last.get("training_iteration"))
+        print(f"{os.path.basename(exp_dir):<40s} trials={n} "
               f"reported={done}")
         found += 1
     if not found:
