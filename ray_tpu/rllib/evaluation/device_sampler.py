@@ -300,10 +300,16 @@ class DeviceSebulbaSampler:
                     len(done_idx), dtype=np.int64)
                 self._eps_counter += len(done_idx)
             self._host_done = np.asarray(dones)
+            # Per-turn accounting (not per-fragment): the bench's
+            # windowed bytes-per-step ratio needs finer ticks than
+            # fragment completions on LOW-rate configs — the full-frame
+            # continuity line completes only ~2-3 fragments per 10s
+            # window, quantizing the ratio by 2-3x. Total per fragment
+            # is unchanged (T ticks of N == N*T).
+            self.steps_total += N
             # Prefetch: inference for the NEXT obs runs while this turn
             # finishes bookkeeping (and while the learner trains).
             self._dispatch_step()
-        self.steps_total += N * T
 
         # The pending step's obs is the post-fragment bootstrap
         # observation AND step 0 of the next fragment — computed once.
