@@ -844,6 +844,16 @@ class Runtime:
     def _request_from_owner(self, ref: ObjectRef):
         """Ask the owner for the value; on completion the result (or error)
         lands in the memory store, or the value is in the shared store."""
+        # Wall clock (time.time): profiler spans across the cluster
+        # merge into one Chrome trace, so every span must share the
+        # epoch the other categories use. Pre-register the start so a
+        # chunked reply's span covers the full request round-trip (the
+        # chunk stream races this thread's reply handling).
+        t_req = time.time()
+        with self._chunk_lock:
+            self._chunk_buf.setdefault(
+                ref.id, {"num": None, "parts": {}, "t0": t_req})
+        status = None
         try:
             try:
                 conn = self._get_conn(ref.owner_addr)
@@ -869,6 +879,9 @@ class Runtime:
                 # bytes in OUR shared store so same-node peers share it.
                 self.shm.put_blob(ref.id, reply["data"])
                 self.memory.put(ref.id, _Cell("shm"))
+                self.profiler.record(
+                    "transfer", f"pull {ref.id.hex()[:12]}", t_req,
+                    time.time(), {"bytes": len(reply["data"])})
             elif status == "shm":
                 self.memory.put(ref.id, _Cell("shm"))
             elif status == "lost":
@@ -878,6 +891,13 @@ class Runtime:
             # 'chunked': object_chunk messages follow on this connection;
             # the chunk handler seals into the local store when complete.
         finally:
+            if status != "chunked":
+                # Drop the pre-registered transfer-start entry (only a
+                # chunk stream consumes it) — also on the error paths.
+                with self._chunk_lock:
+                    buf = self._chunk_buf.get(ref.id)
+                    if buf is not None and not buf["parts"]:
+                        del self._chunk_buf[ref.id]
             self._fetching.discard(ref.id)
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
@@ -1625,16 +1645,30 @@ class Runtime:
     def _on_object_chunk(self, msg: dict):
         oid: ObjectID = msg["object_id"]
         with self._chunk_lock:
+            # Requester-initiated pulls pre-register t0 at request time
+            # (full round-trip span); PUSHED streams (task results)
+            # start at first-chunk arrival — receive-to-seal is the
+            # best locally-observable window (sender clocks differ).
             buf = self._chunk_buf.setdefault(
-                oid, {"num": msg["num_chunks"], "parts": {}})
+                oid, {"num": None, "parts": {}, "t0": time.time()})
+            if buf["num"] is None:
+                buf["num"] = msg["num_chunks"]
             buf["parts"][msg["index"]] = msg["data"]
             done = len(buf["parts"]) == buf["num"]
             if done:
                 parts = [buf["parts"][i] for i in range(buf["num"])]
+                t0 = buf["t0"]
                 del self._chunk_buf[oid]
         if done:
             self.shm.put_blob(oid, parts)
             self.memory.put(oid, _Cell("shm"))
+            # Object-transfer timeline (parity: the reference's
+            # transfer dump, `state.py:744`): one span per inbound
+            # chunked transfer, sized.
+            self.profiler.record(
+                "transfer", f"pull {oid.hex()[:12]}", t0, time.time(),
+                {"bytes": sum(len(p) for p in parts),
+                 "chunks": len(parts)})
 
     def _on_publish(self, msg: dict):
         channel = msg["channel"]
