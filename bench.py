@@ -219,9 +219,25 @@ def bench_anakin(n_dev: int, flops_per_step: float = 0.0):
     peak = chip_peak_flops()
     if peak and flops_per_step:
         mfu = 100.0 * flops_per_step * med / peak
+    telemetry = snapshot_cluster_metrics()
     trainer.stop()
     ray_tpu.shutdown()
-    return med, stddev_pct, reward, mfu
+    return med, stddev_pct, reward, mfu, telemetry
+
+
+def snapshot_cluster_metrics():
+    """Aggregated cluster counters/gauges (incl. the train_* telemetry)
+    captured while the runtime is still up, so BENCH json carries the
+    observability plane's view alongside the throughput numbers."""
+    import ray_tpu
+    try:
+        agg = ray_tpu.cluster_metrics()
+        return {"counters": {k: round(v, 3)
+                             for k, v in sorted(agg["counters"].items())},
+                "gauges": {k: round(v, 6)
+                           for k, v in sorted(agg["gauges"].items())}}
+    except Exception:
+        return None
 
 
 def measure_link_bandwidth_mbps() -> float:
@@ -325,7 +341,7 @@ def main():
     import jax
     n_dev = len(jax.devices())
     kernel, kernel_mfu, train_fpr, fwd_fpr = bench_kernel(n_dev)
-    anakin, anakin_sd, reward, anakin_mfu = bench_anakin(
+    anakin, anakin_sd, reward, anakin_mfu, telemetry = bench_anakin(
         n_dev, flops_per_step=train_fpr + fwd_fpr)
     # Headline host-env line: delta-encoded feeding on the
     # Atari-statistics env (encoding + env disclosed below).
@@ -372,6 +388,7 @@ def main():
         "kernel_per_chip": round(kernel, 1),
         "kernel_vs_baseline": round(kernel / BASELINE_PER_CHIP, 3),
         "kernel_note": "marginal fused-epoch rate w/ forced readback",
+        "cluster_metrics": telemetry,
     }
     if kernel_mfu is not None:
         out["kernel_mfu_pct"] = round(kernel_mfu, 2)

@@ -196,12 +196,34 @@ def timeline(filename: _Optional[str] = None):
     """Cluster-wide Chrome trace of task/actor/user spans (parity:
     `ray.timeline` / `GlobalState.chrome_tracing_dump`, state.py:672).
     Returns the trace event list, or writes JSON to `filename` for
-    chrome://tracing / Perfetto."""
+    chrome://tracing / Perfetto. Submit and exec spans carry flow
+    events (`ph:"s"/"f"` keyed by task id) so viewers draw causality
+    arrows across processes/nodes; a metadata record reports how many
+    spans were dropped to buffer bounds."""
     from ._private import profiling as _prof
-    events = _ws.get_runtime().get_profile_events()
+    dump = _ws.get_runtime().profile_dump()
     if filename is not None:
-        return _prof.dump_chrome_trace(events, filename)
-    return _prof.chrome_trace(events)
+        return _prof.dump_chrome_trace(dump["events"], filename,
+                                       dropped=dump["dropped"])
+    return _prof.chrome_trace(dump["events"], dropped=dump["dropped"])
+
+
+def tasks(state: _Optional[str] = None, name: _Optional[str] = None,
+          limit: int = 100):
+    """Task-lifecycle records from the head's bounded event ring
+    (parity: the reference state API's `ray list tasks`). Each record
+    carries the task's current state (SUBMITTED/QUEUED/LEASED/RUNNING/
+    FINISHED/FAILED), per-state durations, node, worker pid, submitting
+    caller, parent task, and the error for failed tasks."""
+    return _ws.get_runtime().list_tasks(state=state, name=name,
+                                        limit=limit)
+
+
+def task_summary():
+    """Per-state task counts grouped by function/method name (parity:
+    `ray summary tasks`). Also shown by `ray_tpu stat --tasks` and the
+    dashboard's state-summary row."""
+    return _ws.get_runtime().task_summary()
 
 
 def xla_profile(logdir: str):
@@ -249,6 +271,6 @@ __all__ = [
     "cluster_info", "cluster_metrics", "cluster_resources", "exceptions",
     "exit_actor", "free",
     "get", "get_actor", "init", "is_initialized", "kill", "method",
-    "profile", "put", "remote", "shutdown", "timeline", "wait",
-    "xla_profile",
+    "profile", "put", "remote", "shutdown", "task_summary", "tasks",
+    "timeline", "wait", "xla_profile",
 ]

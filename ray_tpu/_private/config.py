@@ -78,6 +78,9 @@ _def("RAY_TPU_LOG_TO_DRIVER", bool, True,
      "Stream worker logs to the driver console")
 _def("RAY_TPU_LOG_LEVEL", str, "WARNING",
      "Python logging level for daemon processes")
+_def("RAY_TPU_TASK_LOG_MAX", int, 4096,
+     "Task-lifecycle records retained in the head's bounded ring "
+     "(ray_tpu.tasks() / task_summary() / stat --tasks)")
 
 # --- actors -----------------------------------------------------------
 _def("RAY_TPU_NUM_ACTOR_CHECKPOINTS_TO_KEEP", int, 20,

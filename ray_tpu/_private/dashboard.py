@@ -28,8 +28,9 @@ pre {{ background: #111; color: #ddd; padding: 8px; max-height: 20em;
 </style></head><body>
 <h1>ray_tpu — session {session}</h1>
 <p>{now} &middot; {n_nodes} node(s) &middot; {n_actors} actor(s)
-&middot; {inflight} in-flight task(s) &middot; {pending} queued</p>
+&middot; tasks: {task_states}</p>
 <h2>Nodes</h2>{nodes}
+<h2>Tasks</h2>{tasks}
 <h2>Actors</h2>{actors}
 <h2>Object store</h2>{store}
 <h2>Recent errors</h2><pre>{errors}</pre>
@@ -54,18 +55,28 @@ def _fmt_res(res: dict) -> str:
 
 def render(head) -> str:
     """Build the page from a HeadServer's live state."""
+    from .task_events import STATES
     with head._lock:
         nodes = [n.view() for n in head._nodes.values()]
         actors = [i.view() for i in head._actors.values()]
-        inflight = len(head._inflight)
-        pending = len(head._pending)
         errors = list(head._recent_errors)
         logs = list(head._recent_logs)
+    task_rows_src = head._task_log.list(limit=20)
+    state_counts = head._task_log.state_counts()
+    task_states = " &middot; ".join(
+        f"{s} {state_counts[s]}" for s in STATES if s in state_counts) \
+        or "(none)"
     agg = head._aggregated_metrics()
+    per_node = agg.get("per_node") or {}
     store_rows = [
-        (html.escape(k), f"{v:g}") for k, v in sorted(
+        (html.escape(k), "total", f"{v:g}") for k, v in sorted(
             agg.get("gauges", {}).items())
         if "store" in k or "memory" in k or "object" in k]
+    for node_id in sorted(per_node):
+        store_rows.extend(
+            (html.escape(k), html.escape(node_id), f"{v:g}")
+            for k, v in sorted(per_node[node_id]["gauges"].items())
+            if "store" in k or "memory" in k or "object" in k)
 
     node_rows = [(
         html.escape(n["node_id"]),
@@ -77,6 +88,19 @@ def render(head) -> str:
          "LOW</span>" if n.get("low_memory")
          else f'{100 * n.get("mem_frac", 0):.0f}%'),
     ) for n in nodes]
+    now = time.time()
+    task_rows = [(
+        html.escape(t["task_id"][:12]),
+        html.escape(t["name"] or "-"),
+        html.escape(t["kind"]),
+        f'<span class="{"dead" if t["state"] == "FAILED" else "alive"}">'
+        f'{html.escape(t["state"])}</span>',
+        html.escape(str(t["node"] or "-")),
+        html.escape(str(t["worker_pid"] or "-")),
+        (f"{(t['end'] - t['start']):.3f}s" if t["end"] and t["start"]
+         else f"{(now - t['start']):.1f}s ago" if t["start"] else "-"),
+        html.escape((t["error"] or "-")[:80]),
+    ) for t in task_rows_src]
     actor_rows = [(
         n["actor_id"].hex()[:12] if hasattr(n["actor_id"], "hex")
         else html.escape(str(n["actor_id"])),
@@ -91,13 +115,16 @@ def render(head) -> str:
         session=html.escape(head.session_name),
         now=time.strftime("%Y-%m-%d %H:%M:%S"),
         n_nodes=len(nodes), n_actors=len(actors),
-        inflight=inflight, pending=pending,
+        task_states=task_states,
         nodes=_table(
             ("node", "state", "total", "available", "mem"), node_rows),
+        tasks=_table(
+            ("task", "name", "kind", "state", "node", "pid", "duration",
+             "error"), task_rows),
         actors=_table(
             ("actor", "name", "state", "restarts left", "death reason"),
             actor_rows),
-        store=_table(("gauge", "value"), store_rows),
+        store=_table(("gauge", "node", "value"), store_rows),
         errors=html.escape("\n".join(errors) or "(none)"),
         logs=html.escape("\n".join(logs) or "(none)"),
     )

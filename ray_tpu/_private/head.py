@@ -37,7 +37,7 @@ from typing import Dict, List, Optional, Set
 from ..exceptions import ActorDiedError, WorkerCrashedError
 from .ids import ActorID, TaskID
 from .task_spec import ACTOR_CREATION_TASK, TaskSpec
-from . import config, protocol
+from . import config, protocol, task_events
 
 logger = logging.getLogger(__name__)
 
@@ -160,6 +160,12 @@ class HeadServer:
         self._token_counter = 0
         self._unregistered_deaths = 0
         self._profile_events: List[dict] = []
+        self._profile_dropped = 0
+        # Task-lifecycle ring (task_events.py; parity: GCS task events):
+        # every submit/queue/lease/run/finish transition in the cluster
+        # lands here, bounded, serving the state API + dashboard.
+        self._task_log = task_events.TaskStateLog(
+            config.get("RAY_TPU_TASK_LOG_MAX"))
         # Deadline-driven node liveness (reference: 100 ms heartbeats x
         # num_heartbeats_timeout=300, `ray_config_def.h:24,28` +
         # `raylet/monitor.cc`): agents heartbeat into the head; a node
@@ -476,9 +482,21 @@ class HeadServer:
         # flag from a reconstruction resubmit would wedge the worker's
         # accounting).
         spec.leased = False
+        self._record_task(spec, task_events.QUEUED)
         with self._lock:
             self._pending.append(spec)
             self._schedule_locked()
+
+    def _record_task(self, spec: TaskSpec, state: str, **attrs):
+        kind = "actor_creation" if spec.kind == ACTOR_CREATION_TASK \
+            else "task"
+        self._task_log.apply({
+            "task_id": spec.task_id.hex(), "state": state,
+            "ts": time.time(), "name": spec.describe(), "kind": kind,
+            "caller": spec.caller_addr or None,
+            "parent": spec.parent_task_id.hex()
+            if spec.parent_task_id else None,
+            **attrs})
 
     # -- worker leases (reference: `HandleRequestWorkerLease`,
     # `node_manager.h:542`; caller-side pipelining lives in runtime.py) --
@@ -672,6 +690,7 @@ class HeadServer:
     # -- actors ----------------------------------------------------------
     def _h_create_actor(self, conn, msg):
         spec: TaskSpec = msg["spec"]
+        self._record_task(spec, task_events.QUEUED)
         with self._lock:
             info = ActorInfo(spec)
             self._actors[spec.actor_id] = info
@@ -879,14 +898,31 @@ class HeadServer:
     def _h_profile_events(self, conn, msg):
         with self._lock:
             self._profile_events.extend(msg["events"])
+            self._profile_dropped += msg.get("dropped", 0)
             if len(self._profile_events) > 200_000:
-                del self._profile_events[
-                    :len(self._profile_events) - 200_000]
+                n = len(self._profile_events) - 200_000
+                del self._profile_events[:n]
+                self._profile_dropped += n
 
     def _h_get_profile_events(self, conn, msg):
         with self._lock:
             events = list(self._profile_events)
-        conn.reply(msg, events=events)
+            dropped = self._profile_dropped
+        conn.reply(msg, events=events, dropped=dropped)
+
+    # -- task lifecycle state API (task_events.py) -----------------------
+    def _h_task_events(self, conn, msg):
+        for ev in msg.get("events", ()):
+            self._task_log.apply(ev)
+
+    def _h_get_tasks(self, conn, msg):
+        conn.reply(
+            msg,
+            tasks=self._task_log.list(state=msg.get("state"),
+                                      name=msg.get("name"),
+                                      limit=msg.get("limit", 100)),
+            summary=self._task_log.summary(),
+            state_counts=self._task_log.state_counts())
 
     # ------------------------------------------------------------------
     # scheduling (lease grant) — runs under self._lock
@@ -973,6 +1009,8 @@ class HeadServer:
                 info.worker_pid = w.pid
                 node.acquire(spec.resources)
                 self._inflight[spec.task_id] = f"token:{w.token}"
+                self._record_task(spec, task_events.LEASED,
+                                  node=node.node_id, pid=w.pid)
                 threading.Thread(
                     target=self._dispatch_when_registered, args=(w, spec),
                     daemon=True).start()
@@ -990,6 +1028,8 @@ class HeadServer:
                     w.current_task = spec
                     node.acquire(spec.resources)
                     self._inflight[spec.task_id] = addr
+                    self._record_task(spec, task_events.LEASED,
+                                      node=node.node_id, pid=w.pid)
                     try:
                         w.conn.send({"kind": "execute_task", "spec": spec})
                     except protocol.ConnectionClosed:
@@ -1255,6 +1295,7 @@ class HeadServer:
                 del self._kv[key]
 
     def _fail_task_to_caller(self, spec: TaskSpec, error: Exception):
+        self._record_task(spec, task_events.FAILED, error=str(error)[:300])
         with self._lock:
             conn = self._conns_by_addr.get(spec.caller_addr)
         if conn is None:

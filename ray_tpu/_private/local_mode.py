@@ -54,9 +54,34 @@ class LocalRuntime:
         self._functions: Dict[str, object] = {}
         self._actors: Dict[ActorID, object] = {}
         self.profiler = _LocalProfiler()
+        from .task_events import TaskStateLog
+        self._task_log = TaskStateLog()
 
     def get_profile_events(self) -> list:
         return list(self.profiler.events)
+
+    def profile_dump(self) -> dict:
+        return {"events": list(self.profiler.events), "dropped": 0}
+
+    # -- task state API (inline records; no head ring in local mode) ----
+    def _record_task(self, name: str, kind: str, error):
+        import os
+        import time
+
+        from . import task_events
+        state = task_events.FAILED if error is not None \
+            else task_events.FINISHED
+        self._task_log.apply({
+            "task_id": TaskID.generate().hex(), "state": state,
+            "ts": time.time(), "name": name, "kind": kind,
+            "node": "local", "pid": os.getpid(),
+            "error": str(error)[:300] if error is not None else None})
+
+    def list_tasks(self, state=None, name=None, limit=100) -> list:
+        return self._task_log.list(state=state, name=name, limit=limit)
+
+    def task_summary(self) -> dict:
+        return self._task_log.summary()
 
     # -- objects ---------------------------------------------------------
     def put(self, value) -> ObjectRef:
@@ -115,6 +140,7 @@ class LocalRuntime:
             result, error = fn(*a, **kw), None
         except Exception as e:
             result, error = None, TaskError.from_exception(e, name or function_key)
+        self._record_task(name or function_key, "task", error)
         return self._store_result(TaskID.generate(), num_returns, result, error)
 
     # -- actors ----------------------------------------------------------
@@ -145,6 +171,7 @@ class LocalRuntime:
             result, error = getattr(inst, method_name)(*a, **kw), None
         except Exception as e:
             result, error = None, TaskError.from_exception(e, method_name)
+        self._record_task(name or method_name, "actor_task", error)
         return self._store_result(TaskID.generate(), num_returns, result, error)
 
     def kill_actor(self, actor_id, no_restart=True):

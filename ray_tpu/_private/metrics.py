@@ -21,6 +21,7 @@ Usage from anywhere inside the runtime (driver, worker, head):
 
 from __future__ import annotations
 
+import re
 import threading
 from typing import Dict
 
@@ -55,25 +56,54 @@ def reset() -> None:
 def aggregate(per_process: Dict[str, dict]) -> Dict[str, dict]:
     """Merge per-process snapshots: counters sum, gauges sum (they are
     per-process quantities like store bytes; a cluster total is the
-    meaningful roll-up)."""
+    meaningful roll-up). The cluster totals lose where the bytes/tasks
+    actually live, so `per_node` additionally carries the same roll-up
+    grouped by node, letting the dashboard and Prometheus label series
+    by node."""
     counters: Dict[str, float] = {}
     gauges: Dict[str, float] = {}
+    per_node: Dict[str, dict] = {}
     for snap in per_process.values():
+        node = per_node.setdefault(
+            snap.get("node") or "node0", {"counters": {}, "gauges": {}})
         for k, v in (snap.get("counters") or {}).items():
             counters[k] = counters.get(k, 0.0) + v
+            node["counters"][k] = node["counters"].get(k, 0.0) + v
         for k, v in (snap.get("gauges") or {}).items():
             gauges[k] = gauges.get(k, 0.0) + v
-    return {"counters": counters, "gauges": gauges}
+            node["gauges"][k] = node["gauges"].get(k, 0.0) + v
+    return {"counters": counters, "gauges": gauges, "per_node": per_node}
+
+
+_INVALID_METRIC_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_name(name: str) -> str:
+    """Prometheus metric names allow [a-zA-Z_:][a-zA-Z0-9_:]* — a metric
+    like `store.used-bytes` must not emit an invalid exposition line."""
+    s = _INVALID_METRIC_CHARS.sub("_", name)
+    if not s or s[0].isdigit():
+        s = "_" + s
+    return s
 
 
 def prometheus_text(agg: Dict[str, dict],
                     prefix: str = "ray_tpu_") -> str:
-    """Prometheus text exposition format (one TYPE line per metric)."""
+    """Prometheus text exposition format (one TYPE line per metric).
+    Gauges additionally expose per-node labeled series when the
+    aggregate carries a `per_node` breakdown."""
+    per_node = agg.get("per_node") or {}
     out = []
     for name, value in sorted((agg.get("counters") or {}).items()):
-        out.append(f"# TYPE {prefix}{name} counter")
-        out.append(f"{prefix}{name} {value:g}")
+        n = prefix + sanitize_name(name)
+        out.append(f"# TYPE {n} counter")
+        out.append(f"{n} {value:g}")
     for name, value in sorted((agg.get("gauges") or {}).items()):
-        out.append(f"# TYPE {prefix}{name} gauge")
-        out.append(f"{prefix}{name} {value:g}")
+        n = prefix + sanitize_name(name)
+        out.append(f"# TYPE {n} gauge")
+        out.append(f"{n} {value:g}")
+        for node_id in sorted(per_node):
+            v = per_node[node_id]["gauges"].get(name)
+            if v is not None:
+                out.append(f'{n}{{node="{node_id}"}} {v:g}')
     return "\n".join(out) + "\n"

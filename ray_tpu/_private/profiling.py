@@ -6,6 +6,13 @@ batching spans to the GCS ProfileTable) + `python/ray/profiling.py:17`
 (`chrome_tracing_dump`). Spans are (category, name, start, end) tuples
 tagged with pid/role; the head aggregates them and `ray_tpu.timeline()`
 renders Chrome-trace JSON viewable in chrome://tracing / Perfetto.
+
+Cross-process causality: spans whose `extra` carries a `flow_id` plus a
+`flow` phase ("s" submit / "t" step / "f" finish) additionally emit
+Chrome flow events (`ph:"s"/"t"/"f"`, keyed by the task id), so Perfetto
+draws arrows from a driver's submit span to the worker's exec span and
+the object-transfer spans of that task's results — instead of
+disconnected per-process lanes.
 """
 
 from __future__ import annotations
@@ -18,6 +25,9 @@ from typing import List, Optional
 
 FLUSH_INTERVAL = 1.0
 MAX_BUFFER = 5000
+
+# Flow phases (Chrome trace event format): start / step / end.
+FLOW_START, FLOW_STEP, FLOW_END = "s", "t", "f"
 
 
 class ProfileEvent:
@@ -49,42 +59,65 @@ class Profiler:
         self.role = role
         self._buf: List[dict] = []
         self._lock = threading.Lock()
-        self._stopped = False
+        self._stop_event = threading.Event()
+        self._dropped_unreported = 0
         self._thread = threading.Thread(
             target=self._flush_loop, daemon=True, name="profiler-flush")
         self._thread.start()
+
+    @property
+    def _stopped(self) -> bool:
+        return self._stop_event.is_set()
 
     def record(self, category: str, name: str, start: float, end: float,
                extra: Optional[dict] = None):
         ev = ProfileEvent(category, name, start, end, os.getpid(),
                           threading.get_ident() % 100000, extra).view()
         ev["role"] = self.role
+        dropped = 0
         with self._lock:
             self._buf.append(ev)
             if len(self._buf) > MAX_BUFFER:
-                del self._buf[:len(self._buf) - MAX_BUFFER]
+                # Drop a chunk, not one-by-one: a submit-heavy process
+                # overflowing between flushes would otherwise pay an
+                # O(buffer) shift per span.
+                dropped = len(self._buf) - MAX_BUFFER + MAX_BUFFER // 10
+                del self._buf[:dropped]
+                self._dropped_unreported += dropped
+        if dropped:
+            # Silent truncation would make a saturated timeline look
+            # complete; count the loss where the metrics plane sees it.
+            from . import metrics
+            metrics.inc("profile_events_dropped", dropped)
 
     def span(self, category: str, name: str, extra: Optional[dict] = None):
         return _Span(self, category, name, extra)
 
     def _flush_loop(self):
-        while not self._stopped:
-            time.sleep(FLUSH_INTERVAL)
+        while not self._stop_event.wait(FLUSH_INTERVAL):
             self.flush()
 
     def flush(self):
         with self._lock:
-            if not self._buf:
+            if not self._buf and not self._dropped_unreported:
                 return
             batch, self._buf = self._buf, []
+            dropped, self._dropped_unreported = self._dropped_unreported, 0
         try:
-            self._runtime.head.send(
-                {"kind": "profile_events", "events": batch})
+            msg = {"kind": "profile_events", "events": batch}
+            if dropped:
+                msg["dropped"] = dropped
+            self._runtime.head.send(msg)
         except Exception:
-            pass
+            with self._lock:
+                self._dropped_unreported += dropped
 
     def stop(self):
-        self._stopped = True
+        """Stop flushing and JOIN the flush thread before the final
+        flush, so shutdown can't race the loop and lose the last
+        batch."""
+        self._stop_event.set()
+        self._thread.join(timeout=2.0)
         self.flush()
 
 
@@ -107,25 +140,44 @@ class _Span:
         return False
 
 
-def chrome_trace(events: List[dict]) -> List[dict]:
+def chrome_trace(events: List[dict], dropped: int = 0) -> List[dict]:
     """Convert head-collected span dicts to Chrome-trace 'X' events
-    (parity: `GlobalState.chrome_tracing_dump`, state.py:672)."""
+    (parity: `GlobalState.chrome_tracing_dump`, state.py:672), plus flow
+    events (`ph:"s"/"t"/"f"`) for spans carrying a flow context, and a
+    metadata record with the cluster-wide dropped-span count."""
     out = []
     for e in events:
+        extra = e.get("extra") or {}
+        pid = f"{e.get('role', '?')}:{e['pid']}"
         out.append({
             "cat": e.get("cat", ""),
             "name": e.get("name", ""),
             "ph": "X",
             "ts": e["start"] * 1e6,          # microseconds
             "dur": (e["end"] - e["start"]) * 1e6,
-            "pid": f"{e.get('role', '?')}:{e['pid']}",
+            "pid": pid,
             "tid": e["tid"],
-            "args": e.get("extra") or {},
+            "args": extra,
         })
+        flow_id = extra.get("flow_id")
+        phase = extra.get("flow")
+        if flow_id and phase in (FLOW_START, FLOW_STEP, FLOW_END):
+            # Flow events bind by (cat, name, id); the ts sits inside the
+            # emitting span so viewers attach the arrow to that slice.
+            flow = {"cat": "task_flow", "name": "task_flow", "ph": phase,
+                    "id": flow_id, "ts": e["start"] * 1e6,
+                    "pid": pid, "tid": e["tid"]}
+            if phase == FLOW_END:
+                flow["bp"] = "e"  # bind to the enclosing slice
+            out.append(flow)
+    if dropped:
+        out.append({"ph": "M", "name": "ray_tpu_profile_events_dropped",
+                    "pid": 0, "tid": 0, "args": {"count": dropped}})
     return out
 
 
-def dump_chrome_trace(events: List[dict], filename: str) -> str:
+def dump_chrome_trace(events: List[dict], filename: str,
+                      dropped: int = 0) -> str:
     with open(filename, "w") as f:
-        json.dump(chrome_trace(events), f)
+        json.dump(chrome_trace(events, dropped=dropped), f)
     return filename

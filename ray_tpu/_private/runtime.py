@@ -37,7 +37,7 @@ from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
                           TaskError, WorkerCrashedError)
 from . import config
 from . import object_ref as object_ref_mod
-from . import protocol, serialization
+from . import protocol, serialization, task_events
 from .ids import ActorID, JobID, ObjectID, TaskID
 from .object_ref import ObjectRef
 from .object_store import INLINE_OBJECT_MAX, MemoryStore, SharedObjectStore
@@ -515,6 +515,10 @@ class Runtime:
 
         from .profiling import Profiler
         self.profiler = Profiler(self, role)
+        # Task-lifecycle transitions observed by THIS process (submits,
+        # leased dispatches, executions) batch to the head's state ring
+        # (task_events.py; parity: the core worker's task-event buffer).
+        self.task_events = task_events.TaskEventBuffer(self)
         # Periodic metric pushes to the head (parity: reporter.py psutil
         # stats + OpenCensus flushes; `ray_tpu stat --metrics` reads the
         # head-side aggregate).
@@ -881,7 +885,9 @@ class Runtime:
                 self.memory.put(ref.id, _Cell("shm"))
                 self.profiler.record(
                     "transfer", f"pull {ref.id.hex()[:12]}", t_req,
-                    time.time(), {"bytes": len(reply["data"])})
+                    time.time(),
+                    {"bytes": len(reply["data"]),
+                     "flow_id": ref.id.task_id().hex(), "flow": "t"})
             elif status == "shm":
                 self.memory.put(ref.id, _Cell("shm"))
             elif status == "lost":
@@ -1004,14 +1010,16 @@ class Runtime:
 
     def submit_task(self, function_key: str, args, kwargs, num_returns=1,
                     resources=None, max_retries=3, name="") -> List[ObjectRef]:
+        t_submit = time.time()
         a, kw = self._prepare_args(args, kwargs)
+        parent = task_events.current_task_id()
         spec = TaskSpec(
             task_id=TaskID.generate(), job_id=self.job_id, kind=NORMAL_TASK,
             function_key=function_key, args=a, kwargs=kw,
             num_returns=num_returns,
             resources=resources if resources is not None else {"CPU": 1.0},
             caller_addr=self.addr, caller_node=self.node_id,
-            max_retries=max_retries, name=name)
+            max_retries=max_retries, name=name, parent_task_id=parent)
         # Pin ref args for the task's lifetime: the TaskSpec's own
         # ObjectRefs die as soon as it is pickled, and an unpinned
         # spilled arg could evict before the worker increfs it
@@ -1028,6 +1036,17 @@ class Runtime:
                 self._freed_returns.pop(old_tid, None)
         from . import metrics as metrics_mod
         metrics_mod.inc("tasks_submitted")
+        self.task_events.record(
+            spec.task_id, task_events.SUBMITTED, name=spec.describe(),
+            kind="task", caller=self.addr,
+            parent=parent.hex() if parent else None)
+        # Submit-site span opening the task's trace flow: the worker's
+        # exec span closes it (`flow: "f"`), giving Perfetto a causality
+        # arrow from this call site to the (possibly cross-node) run.
+        self.profiler.record(
+            "task", f"submit {spec.describe()}", t_submit, time.time(),
+            {"task_id": spec.task_id.hex(),
+             "flow_id": spec.task_id.hex(), "flow": "s"})
         if self._use_leases and self._submit_leased(spec):
             return [ObjectRef(oid, self.addr) for oid in spec.return_ids()]
         self.head.send({"kind": "submit_task", "spec": spec})
@@ -1116,6 +1135,8 @@ class Runtime:
 
     def _push_leased(self, addr: str, spec: TaskSpec):
         spec.leased = True
+        self.task_events.record(spec.task_id, task_events.LEASED,
+                                worker=addr)
         # Conflated send: bursts of submissions coalesce into one
         # message per worker (send failures surface via the worker
         # connection's on_close -> _on_lease_worker_lost, and the
@@ -1309,6 +1330,9 @@ class Runtime:
         # ALIVE/DEAD publish for it).
         self._pin_task_args(spec)
         self._actor_creation_tasks[actor_id] = spec.task_id
+        self.task_events.record(
+            spec.task_id, task_events.SUBMITTED, name=spec.describe(),
+            kind="actor_creation", caller=self.addr)
         self.head.request({"kind": "create_actor", "spec": spec}, timeout=60)
         return actor_id
 
@@ -1324,12 +1348,21 @@ class Runtime:
             key = (actor_id, addr)
             seq = self._actor_seqs.get(key, 0)
             self._actor_seqs[key] = seq + 1
+        parent = task_events.current_task_id()
         spec = TaskSpec(
             task_id=TaskID.generate(), job_id=self.job_id, kind=ACTOR_TASK,
             method_name=method_name, args=a, kwargs=kw,
             num_returns=num_returns, caller_addr=self.addr,
-            caller_node=self.node_id,
+            caller_node=self.node_id, parent_task_id=parent,
             actor_id=actor_id, actor_seq=seq, name=name)
+        self.task_events.record(
+            spec.task_id, task_events.SUBMITTED, name=spec.describe(),
+            kind="actor_task", caller=self.addr,
+            parent=parent.hex() if parent else None)
+        self.profiler.record(
+            "task", f"submit {spec.describe()}", time.time(), time.time(),
+            {"task_id": spec.task_id.hex(),
+             "flow_id": spec.task_id.hex(), "flow": "s"})
         with self._pending_lock:
             self._pending_to_addr.setdefault(addr, {})[spec.task_id] = spec
         try:
@@ -1384,6 +1417,21 @@ class Runtime:
         return self.head.request({"kind": "get_metrics"},
                                  timeout=30)["metrics"]
 
+    def list_tasks(self, state=None, name=None, limit: int = 100) -> list:
+        """Task-lifecycle records from the head's bounded state ring
+        (newest first). Other processes' transitions land on their
+        flush cadence (task_events.FLUSH_INTERVAL)."""
+        self.task_events.flush()
+        return self.head.request(
+            {"kind": "get_tasks", "state": state, "name": name,
+             "limit": limit}, timeout=30)["tasks"]
+
+    def task_summary(self) -> dict:
+        """Per-state task counts grouped by function/method name."""
+        self.task_events.flush()
+        return self.head.request(
+            {"kind": "get_tasks", "limit": 1}, timeout=30)["summary"]
+
     def _metrics_push_loop(self):
         from . import metrics as metrics_mod
         while not self._shutdown_event.wait(self._metrics_interval):
@@ -1407,6 +1455,15 @@ class Runtime:
         self.profiler.flush()
         return self.head.request({"kind": "get_profile_events"},
                                  timeout=30)["events"]
+
+    def profile_dump(self) -> dict:
+        """Spans plus the cluster-wide dropped-span count (the timeline
+        dump surfaces the loss as trace metadata)."""
+        self.profiler.flush()
+        reply = self.head.request({"kind": "get_profile_events"},
+                                  timeout=30)
+        return {"events": reply["events"],
+                "dropped": reply.get("dropped", 0)}
 
     # ==================================================================
     # connections
@@ -1668,7 +1725,8 @@ class Runtime:
             self.profiler.record(
                 "transfer", f"pull {oid.hex()[:12]}", t0, time.time(),
                 {"bytes": sum(len(p) for p in parts),
-                 "chunks": len(parts)})
+                 "chunks": len(parts),
+                 "flow_id": oid.task_id().hex(), "flow": "t"})
 
     def _on_publish(self, msg: dict):
         channel = msg["channel"]
@@ -1822,16 +1880,33 @@ class Runtime:
             logger.warning("could not deliver result %s to %s",
                            msg["object_id"], addr)
 
+    def _record_exec_state(self, spec: TaskSpec, state: str, **attrs):
+        kind = {NORMAL_TASK: "task", ACTOR_TASK: "actor_task",
+                ACTOR_CREATION_TASK: "actor_creation"}[spec.kind]
+        self.task_events.record(
+            spec.task_id, state, name=spec.describe(), kind=kind,
+            node=self.node_id, pid=os.getpid(), **attrs)
+
+    def _exec_span(self, spec: TaskSpec):
+        """Exec-side span closing the task's trace flow (`flow:"f"`)."""
+        return self.profiler.span(
+            "task", spec.describe(),
+            {"task_id": spec.task_id.hex(),
+             "flow_id": spec.task_id.hex(), "flow": "f"})
+
     def _execute_one(self, spec: TaskSpec, fn) -> None:
+        self._record_exec_state(spec, task_events.RUNNING)
+        task_events.set_current_task(spec.task_id)
         try:
             # Low-memory guard (reference memory_monitor.py:64): fail
             # the task with a typed error instead of letting the OOM
             # killer take the whole worker/node.
             self._memory_monitor.raise_if_low_memory(spec.describe())
-            with self.profiler.span("task", spec.describe()):
+            with self._exec_span(spec):
                 args, kwargs = self._resolve_args(spec)
                 result = fn(*args, **kwargs)
             self._deliver_result(spec, result)
+            self._record_exec_state(spec, task_events.FINISHED)
         except SystemExit as e:
             if spec.kind == ACTOR_TASK:
                 # exit_actor(): fail the in-flight call, then exit cleanly
@@ -1839,6 +1914,9 @@ class Runtime:
                 err = ActorDiedError(
                     spec.actor_id.hex() if spec.actor_id else "",
                     "actor exited via exit_actor()")
+                self._record_exec_state(spec, task_events.FAILED,
+                                        error=str(err)[:300])
+                self.task_events.flush()
                 for oid in spec.return_ids():
                     self._push_value(spec.caller_addr, oid, error=err,
                                  node=spec.caller_node)
@@ -1846,15 +1924,21 @@ class Runtime:
                 os._exit(0)
             # A normal task calling sys.exit(): report it, keep the worker.
             err = TaskError(e, "", spec.describe() + " called sys.exit()")
+            self._record_exec_state(spec, task_events.FAILED,
+                                    error=str(err)[:300])
             for oid in spec.return_ids():
                 self._push_value(spec.caller_addr, oid, error=err,
                                  node=spec.caller_node)
         except BaseException as e:  # noqa: BLE001 — report, don't die
             err = e if isinstance(e, TaskError) else \
                 TaskError.from_exception(e, spec.describe())
+            self._record_exec_state(spec, task_events.FAILED,
+                                    error=str(err)[:300])
             for oid in spec.return_ids():
                 self._push_value(spec.caller_addr, oid, error=err,
                                  node=spec.caller_node)
+        finally:
+            task_events.set_current_task(None)
 
     def _deliver_result(self, spec: TaskSpec, result):
         n = spec.num_returns
@@ -1897,12 +1981,17 @@ class Runtime:
             pass
 
     def _execute_actor_creation(self, spec: TaskSpec):
+        self._record_exec_state(spec, task_events.RUNNING)
         try:
-            cls = self.load_function(spec.function_key)
-            args, kwargs = self._resolve_args(spec)
-            instance = cls(*args, **kwargs)
+            with self._exec_span(spec):
+                cls = self.load_function(spec.function_key)
+                args, kwargs = self._resolve_args(spec)
+                instance = cls(*args, **kwargs)
         except BaseException as e:
             import traceback
+            self._record_exec_state(spec, task_events.FAILED,
+                                    error=str(e)[:300])
+            self.task_events.flush()
             self.head.send({"kind": "actor_creation_failed",
                             "actor_id": spec.actor_id,
                             "error": traceback.format_exc()})
@@ -1914,8 +2003,11 @@ class Runtime:
             # `python/ray/actor.py:866` load_checkpoint on reconstruct).
             try:
                 self._restore_actor_checkpoint(spec, instance)
-            except BaseException:
+            except BaseException as e:
                 import traceback
+                self._record_exec_state(spec, task_events.FAILED,
+                                        error=str(e)[:300])
+                self.task_events.flush()
                 self.head.send({"kind": "actor_creation_failed",
                                 "actor_id": spec.actor_id,
                                 "error": traceback.format_exc()})
@@ -1927,6 +2019,7 @@ class Runtime:
             self._pre_actor_tasks = []
         for s in parked:
             self._on_push_task(s)
+        self._record_exec_state(spec, task_events.FINISHED)
         self.head.send({"kind": "actor_ready", "actor_id": spec.actor_id,
                         "addr": self.addr})
 
@@ -2036,15 +2129,20 @@ class Runtime:
 
     async def _run_actor_task_async(self, actor: ActorState, spec: TaskSpec):
         async with actor.sem:
+            self._record_exec_state(spec, task_events.RUNNING)
             try:
-                method = getattr(actor.instance, spec.method_name)
-                args, kwargs = self._resolve_args(spec)
-                result = method(*args, **kwargs)
-                if inspect.isawaitable(result):
-                    result = await result
+                with self._exec_span(spec):
+                    method = getattr(actor.instance, spec.method_name)
+                    args, kwargs = self._resolve_args(spec)
+                    result = method(*args, **kwargs)
+                    if inspect.isawaitable(result):
+                        result = await result
                 self._deliver_result(spec, result)
+                self._record_exec_state(spec, task_events.FINISHED)
             except BaseException as e:
                 err = TaskError.from_exception(e, spec.describe())
+                self._record_exec_state(spec, task_events.FAILED,
+                                        error=str(err)[:300])
                 for oid in spec.return_ids():
                     self._push_value(spec.caller_addr, oid, error=err,
                                  node=spec.caller_node)
@@ -2071,6 +2169,13 @@ class Runtime:
         from . import object_ref as object_ref_mod
         if object_ref_mod._tracker is self.ref_tracker:
             object_ref_mod.set_ref_tracker(None)
+        # Join the flush threads (and ship their final batches) while
+        # the head connection is still up.
+        try:
+            self.profiler.stop()
+            self.task_events.stop()
+        except Exception:
+            pass
         try:
             self.head.close()
         except Exception:

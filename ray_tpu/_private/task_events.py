@@ -1,0 +1,189 @@
+"""Task-lifecycle event log: states, per-process buffers, head-side ring.
+
+Parity: the reference's task state API (GCS task events + `ray summary
+tasks` / `ray list tasks`). Every task and actor-method call records its
+state transitions (SUBMITTED -> QUEUED -> LEASED -> RUNNING ->
+FINISHED/FAILED) with timestamps; transitions observed by the driver and
+workers batch through the control protocol (mirroring the profiler's
+span flushes) into a bounded ring at the head, which serves
+`ray_tpu.tasks()` / `ray_tpu.task_summary()` / `ray_tpu stat --tasks`
+and the dashboard's task table.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+SUBMITTED = "SUBMITTED"
+QUEUED = "QUEUED"
+LEASED = "LEASED"
+RUNNING = "RUNNING"
+FINISHED = "FINISHED"
+FAILED = "FAILED"
+
+# Canonical ordering; late/out-of-order events never regress a record's
+# headline state (a driver's SUBMITTED flushing after the worker's
+# RUNNING must not roll the task back).
+STATES = (SUBMITTED, QUEUED, LEASED, RUNNING, FINISHED, FAILED)
+_RANK = {s: i for i, s in enumerate(STATES)}
+_RANK[FAILED] = _RANK[FINISHED]  # both terminal, equal precedence
+TERMINAL = (FINISHED, FAILED)
+
+FLUSH_INTERVAL = 0.5
+MAX_BUFFER = 10000
+
+# Executing-task context for parent linkage: the worker's exec paths set
+# it around user code so tasks submitted from inside a task carry their
+# parent's id (reference: TaskSpec parent_task_id).
+_current = threading.local()
+
+
+def set_current_task(task_id) -> None:
+    _current.task_id = task_id
+
+
+def current_task_id():
+    return getattr(_current, "task_id", None)
+
+
+class TaskEventBuffer:
+    """Per-process buffer of task state transitions, flushed to the head
+    on a short cadence (mirrors profiling.Profiler; reference: the core
+    worker's task-event buffer pushing to the GCS)."""
+
+    def __init__(self, runtime):
+        self._runtime = runtime
+        self._buf: List[dict] = []
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._flush_loop, daemon=True, name="task-events-flush")
+        self._thread.start()
+
+    def record(self, task_id, state: str, **attrs) -> None:
+        ev = {"task_id": task_id if isinstance(task_id, str)
+              else task_id.hex(),
+              "state": state, "ts": time.time()}
+        for k, v in attrs.items():
+            if v is not None:
+                ev[k] = v
+        with self._lock:
+            self._buf.append(ev)
+            if len(self._buf) > MAX_BUFFER:
+                # Chunked drop (see profiling.Profiler.record): amortizes
+                # the list shift when a submit storm outruns the flush.
+                n = len(self._buf) - MAX_BUFFER + MAX_BUFFER // 10
+                del self._buf[:n]
+                from . import metrics
+                metrics.inc("task_events_dropped", n)
+
+    def _flush_loop(self):
+        while not self._stop.wait(FLUSH_INTERVAL):
+            self.flush()
+
+    def flush(self):
+        with self._lock:
+            if not self._buf:
+                return
+            batch, self._buf = self._buf, []
+        try:
+            self._runtime.head.send(
+                {"kind": "task_events", "events": batch})
+        except Exception:
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=2.0)
+        self.flush()
+
+
+class TaskStateLog:
+    """Bounded ring of task records at the head (parity: the GCS task
+    events table). Insertion-ordered; oldest records evict first."""
+
+    def __init__(self, max_tasks: int = 4096):
+        self._max = max(1, int(max_tasks))
+        self._records: "OrderedDict[str, dict]" = OrderedDict()
+        self._lock = threading.Lock()
+
+    def apply(self, ev: dict) -> None:
+        tid = ev.get("task_id")
+        state = ev.get("state")
+        if not tid or state not in _RANK:
+            return
+        with self._lock:
+            rec = self._records.get(tid)
+            if rec is None:
+                rec = {"task_id": tid, "name": "", "kind": "task",
+                       "state": state, "node": None, "worker_pid": None,
+                       "caller": None, "parent_task_id": None,
+                       "error": None, "events": []}
+                self._records[tid] = rec
+                while len(self._records) > self._max:
+                    self._records.popitem(last=False)
+            rec["events"].append((state, float(ev.get("ts") or time.time())))
+            if _RANK[state] >= _RANK[rec["state"]]:
+                rec["state"] = state
+            for src, dst in (("name", "name"), ("kind", "kind"),
+                             ("node", "node"), ("pid", "worker_pid"),
+                             ("caller", "caller"),
+                             ("parent", "parent_task_id"),
+                             ("error", "error")):
+                if ev.get(src) is not None:
+                    rec[dst] = ev[src]
+
+    @staticmethod
+    def _view(rec: dict) -> dict:
+        events = sorted(rec["events"], key=lambda e: e[1])
+        durations: Dict[str, float] = {}
+        for (state, ts), (_nstate, nts) in zip(events, events[1:]):
+            durations[state] = durations.get(state, 0.0) \
+                + max(0.0, nts - ts)
+        out = {k: rec[k] for k in ("task_id", "name", "kind", "state",
+                                   "node", "worker_pid", "caller",
+                                   "parent_task_id", "error")}
+        out["start"] = events[0][1] if events else None
+        out["end"] = events[-1][1] \
+            if events and rec["state"] in TERMINAL else None
+        out["durations"] = durations
+        out["events"] = events
+        return out
+
+    def list(self, state: Optional[str] = None, name: Optional[str] = None,
+             limit: int = 100) -> List[dict]:
+        """Newest-first record views, optionally filtered."""
+        with self._lock:
+            recs = list(self._records.values())
+        out = []
+        for rec in reversed(recs):
+            if state is not None and rec["state"] != state:
+                continue
+            if name is not None and rec["name"] != name:
+                continue
+            out.append(self._view(rec))
+            if limit and len(out) >= limit:
+                break
+        return out
+
+    def summary(self) -> Dict[str, Dict[str, int]]:
+        """Per-state counts grouped by function/method name (parity:
+        `ray summary tasks`)."""
+        with self._lock:
+            recs = list(self._records.values())
+        out: Dict[str, Dict[str, int]] = {}
+        for rec in recs:
+            per = out.setdefault(rec["name"] or rec["task_id"][:12], {})
+            per[rec["state"]] = per.get(rec["state"], 0) + 1
+        return out
+
+    def state_counts(self) -> Dict[str, int]:
+        with self._lock:
+            recs = list(self._records.values())
+        out: Dict[str, int] = {}
+        for rec in recs:
+            out[rec["state"]] = out.get(rec["state"], 0) + 1
+        return out

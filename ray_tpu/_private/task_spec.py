@@ -49,6 +49,10 @@ class TaskSpec:
     # results over the wire instead of pointing at its local shared store.
     caller_node: str = ""
     actor_id: Optional[ActorID] = None
+    # Trace context: the task (if any) whose execution submitted this one
+    # — links driver/worker submit sites to executions in the task
+    # lifecycle log and the timeline's flow events.
+    parent_task_id: Optional[TaskID] = None
     # Per (caller, actor) sequence number for ordered actor task streams
     # (reference: direct_actor_transport.h sequence_number).
     actor_seq: int = 0

@@ -158,10 +158,13 @@ class Trainer(Trainable):
     def _train(self) -> dict:
         """One training iteration with worker-failure retry (parity:
         `Trainer.train`, trainer.py:425)."""
+        import time
         for attempt in range(3):
+            t0 = time.monotonic()
             try:
                 result = self._train_inner()
                 self._maybe_evaluate(result)
+                self._push_train_metrics(result, time.monotonic() - t0)
                 return result
             except RayError as e:
                 if not self.config.get("ignore_worker_failures"):
@@ -169,6 +172,46 @@ class Trainer(Trainable):
                 logger.warning("worker failure: %s; recreating workers", e)
                 self._recover_workers()
         raise RuntimeError("training failed after worker recovery attempts")
+
+    def _push_train_metrics(self, result: dict, iter_time: float):
+        """Per-iteration timing/throughput into the cluster metrics
+        plane, so the Prometheus endpoint (`ray_tpu_train_*`) and
+        dashboard cover training health, not just the object store.
+        Gauges hold the LAST iteration's values; the runtime's metric
+        push loop ships them to the head on its cadence."""
+        from ray_tpu._private import metrics as metrics_mod
+        opt = getattr(self, "optimizer", None)
+        metrics_mod.inc("train_iterations")
+        metrics_mod.set_gauge("train_iter_time_s", iter_time)
+        steps = float(result.get("timesteps_this_iter") or 0)
+        if iter_time > 0:
+            metrics_mod.set_gauge("train_env_throughput",
+                                  steps / iter_time)
+        # Per-iteration phase breakdown from the optimizer's cumulative
+        # timers (sample wait / learn / weight exchange).
+        last = getattr(self, "_last_timer_totals", {})
+        totals = {}
+        for key, gauge in (("sample", "train_sample_time_s"),
+                           ("learn", "train_learn_time_s"),
+                           ("allreduce", "train_allreduce_time_s")):
+            timer = (getattr(opt, "timers", None) or {}).get(key)
+            if timer is None:
+                continue
+            totals[key] = timer.total
+            metrics_mod.set_gauge(
+                gauge, max(0.0, timer.total - last.get(key, 0.0)))
+        if iter_time > 0 and "sample" in totals:
+            metrics_mod.set_gauge(
+                "train_sample_wait_fraction",
+                max(0.0, totals["sample"] - last.get("sample", 0.0))
+                / iter_time)
+        trained = float(getattr(opt, "num_steps_trained", 0) or 0)
+        last_trained = getattr(self, "_last_steps_trained_metric", 0.0)
+        if iter_time > 0:
+            metrics_mod.set_gauge("train_learner_throughput",
+                                  (trained - last_trained) / iter_time)
+        self._last_steps_trained_metric = trained
+        self._last_timer_totals = totals
 
     def _train_inner(self) -> dict:
         raise NotImplementedError

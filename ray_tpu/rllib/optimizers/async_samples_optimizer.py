@@ -170,6 +170,9 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
             num_sgd_iter=num_sgd_iter,
             sgd_minibatch_size=sgd_minibatch_size,
             sgd_sequence_length=sgd_sequence_length)
+        # The learner thread's grad timer IS this optimizer's learn
+        # phase — alias it so the trainer's train_* gauges see it.
+        self.timers["learn"] = self.learner.grad_timer
         self.learner.start()
 
         self.sample_tasks = TaskPool()

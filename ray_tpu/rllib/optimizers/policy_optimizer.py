@@ -6,22 +6,60 @@ sample collection + learning; counters feed the trainer's result dict.
 
 from __future__ import annotations
 
+import time
+
+
+class Timer:
+    """Context-manager timer (parity: `ray.timer.TimerStat`). Optimizers
+    accumulate sample/learn/allreduce wall time here; the trainer turns
+    per-iteration deltas into `train_*` gauges."""
+
+    __slots__ = ("total", "count", "_start")
+
+    def __init__(self):
+        self.total = 0.0
+        self.count = 0
+        self._start = None
+
+    def __enter__(self):
+        self._start = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.total += time.perf_counter() - self._start
+        self.count += 1
+        return False
+
+    @property
+    def mean(self) -> float:
+        return self.total / self.count if self.count else 0.0
+
 
 class PolicyOptimizer:
     def __init__(self, workers):
         self.workers = workers
         self.num_steps_trained = 0
         self.num_steps_sampled = 0
+        # Standard phase timers; subclasses time their phases into these
+        # (or alias their own Timer-shaped stats in, see
+        # AsyncSamplesOptimizer) so the trainer's telemetry push reads
+        # one vocabulary.
+        self.timers = {"sample": Timer(), "learn": Timer(),
+                       "allreduce": Timer()}
 
     def step(self) -> dict:
         """One optimization round; returns learner stats."""
         raise NotImplementedError
 
     def stats(self) -> dict:
-        return {
+        out = {
             "num_steps_trained": self.num_steps_trained,
             "num_steps_sampled": self.num_steps_sampled,
         }
+        for key, timer in self.timers.items():
+            if timer.count:
+                out[f"{key}_time_ms"] = round(1000 * timer.mean, 3)
+        return out
 
     def save(self):
         """Persist progress counters so resumed runs keep schedules

@@ -51,10 +51,15 @@ class SyncSamplesOptimizer(PolicyOptimizer):
         self.learner_stats = {}
 
     def step(self) -> dict:
-        self.workers.sync_weights()
-        batch = collect_train_batch(self.workers, self.train_batch_size)
-        self.workers.sync_filters()
-        self.learner_stats = self.workers.local_worker.learn_on_batch(batch)
+        with self.timers["allreduce"]:
+            self.workers.sync_weights()
+        with self.timers["sample"]:
+            batch = collect_train_batch(self.workers,
+                                        self.train_batch_size)
+            self.workers.sync_filters()
+        with self.timers["learn"]:
+            self.learner_stats = \
+                self.workers.local_worker.learn_on_batch(batch)
         n = real_count(batch)
         self.num_steps_sampled += n
         self.num_steps_trained += n
@@ -90,9 +95,20 @@ class MultiDeviceOptimizer(PolicyOptimizer):
         return batch
 
     def step(self) -> dict:
-        self.workers.sync_weights()
-        batch = collect_train_batch(self.workers, self.train_batch_size)
-        self.workers.sync_filters()
+        with self.timers["allreduce"]:
+            self.workers.sync_weights()
+        with self.timers["sample"]:
+            batch = collect_train_batch(self.workers,
+                                        self.train_batch_size)
+            self.workers.sync_filters()
+        with self.timers["learn"]:
+            self._learn(batch)
+        n = real_count(batch)
+        self.num_steps_sampled += n
+        self.num_steps_trained += n
+        return self.learner_stats
+
+    def _learn(self, batch):
         if isinstance(batch, MultiAgentBatch):
             # Per-policy SGD phases (parity: the reference routes
             # multi-agent through per-policy learn_on_batch).
@@ -117,7 +133,3 @@ class MultiDeviceOptimizer(PolicyOptimizer):
                 mb = max(seq_len, (mb // seq_len) * seq_len)
             self.learner_stats = policy.sgd_learn(
                 batch, self.num_sgd_iter, mb, seq_len=seq_len)
-        n = real_count(batch)
-        self.num_steps_sampled += n
-        self.num_steps_trained += n
-        return self.learner_stats

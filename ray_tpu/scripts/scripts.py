@@ -323,6 +323,28 @@ def cmd_stat(args):
     address = _resolve_address(args)
     conn = _connect(address)
     try:
+        if getattr(args, "tasks", False):
+            reply = conn.request({"kind": "get_tasks", "limit": 40},
+                                 timeout=30)
+            counts = reply.get("state_counts") or {}
+            print("task states: " + (" ".join(
+                f"{s}={counts[s]}" for s in sorted(counts)) or "(none)"))
+            print("summary (func x state):")
+            for nm, per in sorted((reply.get("summary") or {}).items()):
+                row = " ".join(f"{s}={c}" for s, c in sorted(per.items()))
+                print(f"  {nm:<28s} {row}")
+            print("recent tasks:")
+            print(f"  {'task':<14s} {'name':<24s} {'state':<10s} "
+                  f"{'node':<8s} {'pid':<7s} {'dur':<9s} error")
+            for t in reply.get("tasks") or []:
+                dur = f"{t['end'] - t['start']:.3f}s" \
+                    if t.get("end") and t.get("start") else "-"
+                print(f"  {t['task_id'][:12]:<14s} "
+                      f"{(t['name'] or '-')[:23]:<24s} "
+                      f"{t['state']:<10s} {str(t['node'] or '-'):<8s} "
+                      f"{str(t['worker_pid'] or '-'):<7s} {dur:<9s} "
+                      f"{(t['error'] or '')[:40]}")
+            return
         if getattr(args, "metrics", False):
             agg = conn.request({"kind": "get_metrics"},
                                timeout=30)["metrics"]
@@ -381,15 +403,17 @@ def cmd_timeline(args):
     address = _resolve_address(args)
     conn = _connect(address)
     try:
-        events = conn.request({"kind": "get_profile_events"},
-                              timeout=30)["events"]
+        reply = conn.request({"kind": "get_profile_events"}, timeout=30)
+        events, dropped = reply["events"], reply.get("dropped", 0)
     finally:
         conn.close()
     from ray_tpu._private.profiling import dump_chrome_trace
     out = args.out or f"ray-tpu-timeline-{int(time.time())}.json"
-    dump_chrome_trace(events, out)
+    dump_chrome_trace(events, out, dropped=dropped)
     print(f"wrote {len(events)} span(s) to {out} "
-          f"(open in chrome://tracing or Perfetto)")
+          f"(open in chrome://tracing or Perfetto)"
+          + (f"; {dropped} span(s) dropped to buffer bounds"
+             if dropped else ""))
 
 
 def main(argv=None):
@@ -450,6 +474,10 @@ def main(argv=None):
             p.add_argument("--metrics", action="store_true",
                            help="print cluster-aggregated counters/"
                                 "gauges instead of resource state")
+            p.add_argument("--tasks", action="store_true",
+                           help="print the task-lifecycle state table "
+                                "(per-state counts, func x state "
+                                "summary, recent tasks)")
             p.add_argument("--config", action="store_true",
                            help="dump the tunable-config registry "
                                 "(effective values; * = env override)")

@@ -152,6 +152,86 @@ class TestMetrics:
         finally:
             ray_tpu.shutdown()
 
+    def test_prometheus_name_sanitization(self):
+        """Dots/dashes/spaces in metric names must not emit invalid
+        exposition lines (Prometheus names are [a-zA-Z0-9_:] only)."""
+        import re
+
+        from ray_tpu._private import metrics
+        text = metrics.prometheus_text({
+            "counters": {"store.used-bytes": 1.0, "9lives": 2.0},
+            "gauges": {"a b/c": 3.0}})
+        assert "ray_tpu_store_used_bytes 1" in text
+        assert "ray_tpu__9lives 2" in text
+        assert "ray_tpu_a_b_c 3" in text
+        name_re = re.compile(
+            r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [-+0-9.einf]+$")
+        for line in text.strip().splitlines():
+            if line.startswith("#"):
+                continue
+            assert name_re.match(line), f"invalid exposition line {line!r}"
+
+    def test_aggregate_per_node_breakdown(self):
+        from ray_tpu._private import metrics
+        agg = metrics.aggregate({
+            "addr1": {"node": "node0", "counters": {"c": 1.0},
+                      "gauges": {"g": 10.0}},
+            "addr2": {"node": "node0", "gauges": {"g": 5.0}},
+            "addr3": {"node": "node1", "gauges": {"g": 2.0}},
+        })
+        assert agg["counters"]["c"] == 1.0
+        assert agg["gauges"]["g"] == 17.0  # cluster total preserved
+        assert agg["per_node"]["node0"]["gauges"]["g"] == 15.0
+        assert agg["per_node"]["node1"]["gauges"]["g"] == 2.0
+        text = metrics.prometheus_text(agg)
+        assert 'ray_tpu_g{node="node0"} 15' in text
+        assert 'ray_tpu_g{node="node1"} 2' in text
+
+    def test_trainer_iteration_gauges(self, monkeypatch):
+        """A training iteration pushes its timing breakdown into the
+        metrics plane: the Prometheus endpoint exposes ray_tpu_train_*
+        gauges during a (short) PPO run."""
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        monkeypatch.setenv("RAY_TPU_METRICS_PORT", str(port))
+        monkeypatch.setenv("RAY_TPU_METRICS_INTERVAL_S", "0.3")
+        ray_tpu.init(num_cpus=2)
+        t = None
+        try:
+            from ray_tpu.rllib.agents.ppo import PPOTrainer
+            t = PPOTrainer(config={
+                "env": "CartPole-v0", "num_workers": 0,
+                "train_batch_size": 128, "sgd_minibatch_size": 32,
+                "num_sgd_iter": 2, "rollout_fragment_length": 64,
+                "num_envs_per_worker": 1,
+                "model": {"fcnet_hiddens": [16]}, "seed": 0})
+            t.train()
+            deadline = time.monotonic() + 15
+            text = ""
+            while time.monotonic() < deadline:
+                text = urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) \
+                    .read().decode()
+                if "ray_tpu_train_iter_time_s" in text:
+                    break
+                time.sleep(0.3)
+            for gauge in ("ray_tpu_train_iter_time_s",
+                          "ray_tpu_train_sample_time_s",
+                          "ray_tpu_train_learn_time_s",
+                          "ray_tpu_train_env_throughput",
+                          "ray_tpu_train_learner_throughput"):
+                assert gauge in text, f"{gauge} missing from exposition"
+            agg = ray_tpu.cluster_metrics()
+            assert agg["counters"]["train_iterations"] >= 1
+            assert agg["gauges"]["train_iter_time_s"] > 0
+        finally:
+            if t is not None:
+                t.stop()
+            ray_tpu.shutdown()
+
     def test_stat_metrics_cli(self, monkeypatch):
         monkeypatch.setenv("RAY_TPU_METRICS_INTERVAL_S", "0.3")
         ray_tpu.init(num_cpus=2)

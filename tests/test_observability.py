@@ -117,6 +117,146 @@ def test_xla_profile_captures_device_trace(tmp_path):
     assert any(os.path.isfile(f) for f in found), found
 
 
+class TestTaskStateAPI:
+    """Task-lifecycle state API (parity: the reference state API's
+    `ray list tasks` / `ray summary tasks`): transitions recorded by
+    driver, head, and workers land in the head's bounded ring."""
+
+    def test_finished_task_records_per_state_durations(self, ray_start):
+        @ray_tpu.remote
+        def ok(x):
+            return x
+
+        assert ray_tpu.get(ok.remote(1), timeout=30) == 1
+        rec = _poll_task_record("ok", "FINISHED")
+        assert rec["node"] == "node0"
+        assert rec["worker_pid"] is not None
+        assert rec["caller"]  # submitting driver's addr
+        # Per-state durations: the task passed through SUBMITTED and
+        # RUNNING at minimum, each with a non-negative residence time.
+        assert rec["durations"].get("SUBMITTED", -1) >= 0
+        assert rec["durations"].get("RUNNING", -1) >= 0
+        assert rec["end"] >= rec["start"]
+        summary = ray_tpu.task_summary()
+        assert summary["ok"]["FINISHED"] >= 1
+
+    def test_failed_task_lands_in_failed_with_error(self, ray_start):
+        @ray_tpu.remote
+        def boom():
+            raise ValueError("task-state-boom")
+
+        with pytest.raises(Exception):
+            ray_tpu.get(boom.remote(), timeout=30)
+        rec = _poll_task_record("boom", "FAILED")
+        assert "task-state-boom" in (rec["error"] or "")
+        assert ray_tpu.task_summary()["boom"]["FAILED"] >= 1
+        # Filters select by state.
+        failed = ray_tpu.tasks(state="FAILED")
+        assert all(r["state"] == "FAILED" for r in failed)
+        assert any(r["name"] == "boom" for r in failed)
+
+    def test_actor_method_calls_recorded(self, ray_start):
+        @ray_tpu.remote
+        class Counter:
+            def __init__(self):
+                self.n = 0
+
+            def inc(self):
+                self.n += 1
+                return self.n
+
+        c = Counter.remote()
+        assert ray_tpu.get(c.inc.remote(), timeout=30) == 1
+        rec = _poll_task_record("Counter.inc", "FINISHED")
+        assert rec["kind"] == "actor_task"
+
+
+def _poll_task_record(name, state, timeout=10):
+    """Worker-side transitions flush on a short cadence; poll."""
+    deadline = time.monotonic() + timeout
+    last = []
+    while time.monotonic() < deadline:
+        last = ray_tpu.tasks(name=name)
+        if last and last[0]["state"] == state:
+            return last[0]
+        time.sleep(0.2)
+    raise AssertionError(
+        f"no task {name!r} reached {state}; saw {last}")
+
+
+def test_flow_events_link_submit_to_exec_across_nodes():
+    """The Chrome trace carries flow events (`ph:"s"` at the driver's
+    submit span, `ph:"f"` at the worker's exec span, keyed by task id)
+    so Perfetto draws causality arrows across process/node lanes."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+    cluster = Cluster(head_resources={"CPU": 1})
+    cluster.add_node(resources={"CPU": 2})
+    try:
+        # The resource shape only fits the second node: the submit
+        # side (driver) and exec side (remote worker) are guaranteed
+        # to be different processes on different nodes.
+        @ray_tpu.remote(resources={"CPU": 2})
+        def remote_work():
+            return os.getpid()
+
+        worker_pid = ray_tpu.get(remote_work.remote(), timeout=60)
+        assert worker_pid != os.getpid()
+        deadline = time.time() + 15
+        cross = []
+        while time.time() < deadline and not cross:
+            events = ray_tpu.timeline()
+            starts = {e["id"]: e for e in events if e.get("ph") == "s"}
+            ends = {e["id"]: e for e in events if e.get("ph") == "f"}
+            cross = [fid for fid in starts.keys() & ends.keys()
+                     if starts[fid]["pid"] != ends[fid]["pid"]]
+            if not cross:
+                time.sleep(0.5)
+        assert cross, "no cross-process flow s->f pair in the trace"
+        fid = cross[0]
+        events = ray_tpu.timeline()
+        # The flow binds a driver-side submit span to the worker-side
+        # exec span carrying the same task id.
+        sub = [e for e in events if e.get("ph") == "X"
+               and (e.get("args") or {}).get("task_id") == fid
+               and e["name"].startswith("submit ")]
+        ex = [e for e in events if e.get("ph") == "X"
+              and (e.get("args") or {}).get("task_id") == fid
+              and not e["name"].startswith("submit ")]
+        assert sub and ex
+        assert str(worker_pid) in str(ex[0]["pid"])
+    finally:
+        cluster.shutdown()
+
+
+def test_profiler_drop_accounting_and_joined_stop(ray_start):
+    """Span-buffer truncation is counted (not silent) and surfaces in
+    the timeline dump's metadata; Profiler.stop() joins the flush
+    thread so the final batch can't be lost."""
+    from ray_tpu._private import metrics as metrics_mod
+    from ray_tpu._private import profiling, worker_state
+    rt = worker_state.get_runtime()
+    # Overflow the local buffer; the 1 s background flush could steal
+    # one batch mid-loop, so retry until a drop registers.
+    for _ in range(3):
+        for i in range(profiling.MAX_BUFFER + 500):
+            rt.profiler.record("user", f"spam-{i % 7}", 0.0, 0.0)
+        if metrics_mod.snapshot()["counters"].get(
+                "profile_events_dropped", 0) > 0:
+            break
+    assert metrics_mod.snapshot()["counters"].get(
+        "profile_events_dropped", 0) > 0
+    rt.profiler.flush()
+    events = ray_tpu.timeline()
+    meta = [e for e in events
+            if e.get("ph") == "M"
+            and e.get("name") == "ray_tpu_profile_events_dropped"]
+    assert meta and meta[0]["args"]["count"] > 0
+    # stop() must terminate AND join the flush thread.
+    rt.profiler.stop()
+    assert not rt.profiler._thread.is_alive()
+
+
 def test_object_transfer_spans_in_timeline():
     """Cross-node object pulls appear in the cluster timeline as sized
     'transfer' spans (parity: the reference's object-transfer timeline,
