@@ -93,10 +93,15 @@ def compiled_flops(jitted, *args) -> float:
 
 def median_windows(run_window, n: int = 3):
     """Run `run_window() -> (rate, extra)` n times; return
-    (median_rate, stddev_pct, extra-of-median-window, all_rates)."""
+    (median_rate, stddev_pct, extra-of-median-window, all_rates).
+
+    median_low, not median: an even window count's true median is the
+    MEAN of the middle two, which belongs to no window — rates.index()
+    would then crash looking up its extra. median_low always names a
+    real window."""
     out = [run_window() for _ in range(n)]
     rates = [r for r, _ in out]
-    med = statistics.median(rates)
+    med = statistics.median_low(rates)
     extra = out[rates.index(med)][1]
     stddev_pct = (100.0 * statistics.pstdev(rates) / med) if med else 0.0
     return med, round(stddev_pct, 1), extra, [round(r, 1) for r in rates]
@@ -319,6 +324,17 @@ def bench_sebulba(n_dev: int, env: str, obs_delta, n_actors: int,
             "learner_busy_pct": round(
                 100 * (opt.learner.grad_timer.total - g0) / dt, 1),
         }
+        # Wire-codec view of the obs stream (sampled probe through the
+        # runtime's StreamEncoder): what the striped data plane would
+        # ship per step on a host-to-host wire vs the raw bytes.
+        pw_raw = s1.get("wire_probe_raw", 0) - s0.get("wire_probe_raw", 0)
+        pw_wire = (s1.get("wire_probe_wire", 0)
+                   - s0.get("wire_probe_wire", 0))
+        if pw_raw > 0:
+            ratio = pw_wire / pw_raw
+            acct["wire_codec_ratio"] = round(ratio, 3)
+            acct["wire_bytes_per_step"] = round(
+                acct["bytes_per_step"] * ratio, 1)
         return trained / dt / n_dev, acct
 
     med, stddev_pct, acct, rates = median_windows(window, windows)

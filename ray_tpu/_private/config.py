@@ -50,6 +50,31 @@ _def("RAY_TPU_EXPORT_PIN_TIMEOUT_S", float, 120.0,
 _def("RAY_TPU_LINEAGE_MAX_SPECS", int, 10000,
      "Retained task specs for owner-side result reconstruction (LRU)")
 
+# --- inter-node data plane (striped transfers + wire codec) -----------
+_def("RAY_TPU_TRANSFER_STREAMS", int, min(4, os.cpu_count() or 1),
+     "Transfer connections per peer for large-object striping "
+     "(<=1 reverts to the single-stream control-connection path; "
+     "default scales with cores — stripe threads on a 1-core box "
+     "only add handoffs)")
+_def("RAY_TPU_OBJECT_CHUNK_SIZE", int, 8 * 1024 * 1024,
+     "Max bytes per inter-node object chunk")
+_def("RAY_TPU_WIRE_STRIPE_MIN", int, 512 * 1024,
+     "Objects at or below this ship as one message on the control "
+     "connection; larger ones stripe across the transfer pool")
+_def("RAY_TPU_WIRE_COMPRESSION", str, "auto",
+     "Per-chunk wire compression: on | off | auto (auto skips the "
+     "codec on links faster than the codec itself)")
+_def("RAY_TPU_WIRE_COMPRESSION_MIN_RATIO", float, 0.9,
+     "Probe/chunk compression ratio that must be beaten for a chunk "
+     "to ship compressed")
+_def("RAY_TPU_WIRE_COMPRESSION_MAX_LINK_MBPS", float, 200.0,
+     "In auto mode, peers whose observed wire throughput exceeds this "
+     "skip the codec (compressing for a link faster than the codec "
+     "only adds latency)")
+_def("RAY_TPU_GET_PREFETCH", int, 8,
+     "Parallel fetch window for multi-ref get()/wait(): pending "
+     "foreign refs are requested concurrently up to this many at once")
+
 # --- worker leases ----------------------------------------------------
 _def("RAY_TPU_DISABLE_LEASES", bool, False,
      "Route every task through the head instead of worker leases")

@@ -17,6 +17,13 @@ Usage from anywhere inside the runtime (driver, worker, head):
     from ray_tpu._private import metrics
     metrics.inc("tasks_executed")
     metrics.set_gauge("store_used_bytes", n)
+
+Data-plane series (striped transfers + wire codec, runtime.py):
+counters `wire_bytes_on_wire` / `wire_bytes_raw` / `wire_bytes_saved` /
+`wire_bytes_recv` / `wire_chunks_compressed` / `wire_chunks_raw` /
+`wire_stripe_retries`; gauges `wire_stripes_active` (objects currently
+striping out) and `wire_send_mbps` (per-peer throughput EMA summed per
+process — the per_node breakdown keeps it attributable).
 """
 
 from __future__ import annotations

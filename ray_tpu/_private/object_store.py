@@ -120,6 +120,45 @@ class _Pin:
         self.mm = mm
 
 
+class ReceiveBuffer:
+    """Offset-addressed destination for one inbound striped transfer.
+
+    write_at() is os.pwrite on a pre-truncated file: stripes arriving
+    out of order on different transfer connections land concurrently
+    (pwrite is thread-safe and positionless) with zero intermediate
+    copies. seal() atomically renames into the store namespace; abort()
+    discards the partial file so a failed transfer never surfaces."""
+
+    __slots__ = ("_tmp", "_path", "_fd", "total")
+
+    def __init__(self, tmp: str, path: str, total: int):
+        self._tmp = tmp
+        self._path = path
+        self.total = total
+        self._fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
+        os.ftruncate(self._fd, max(total, 1))
+
+    def write_at(self, offset: int, data) -> None:
+        os.pwrite(self._fd, data, offset)
+
+    def seal(self) -> None:
+        os.close(self._fd)
+        self._fd = -1
+        os.rename(self._tmp, self._path)
+
+    def abort(self) -> None:
+        if self._fd >= 0:
+            try:
+                os.close(self._fd)
+            except OSError:
+                pass
+            self._fd = -1
+        try:
+            os.unlink(self._tmp)
+        except OSError:
+            pass
+
+
 class SharedObjectStore:
     """Shared-memory object store over /dev/shm files."""
 
@@ -166,6 +205,17 @@ class SharedObjectStore:
             for p in parts:
                 f.write(p)
         os.rename(tmp, path)
+
+    def create_receive(self, oid: ObjectID, total: int) -> "ReceiveBuffer":
+        """Pre-sized landing zone for an inbound striped transfer:
+        stripes pwrite at their blob offsets directly into the store
+        file (no per-chunk buffering, no assembly copy), and seal()
+        renames it into place exactly like put_blob. The tmp name is
+        unique per receive so concurrent fetchers of one object can't
+        corrupt each other's seal."""
+        path = self._path(oid)
+        tmp = f"{path}.rx{os.getpid()}-{os.urandom(2).hex()}"
+        return ReceiveBuffer(tmp, path, total)
 
     def blob_size(self, oid: ObjectID) -> Optional[int]:
         try:

@@ -149,6 +149,15 @@ def chrome_trace(events: List[dict], dropped: int = 0) -> List[dict]:
     for e in events:
         extra = e.get("extra") or {}
         pid = f"{e.get('role', '?')}:{e['pid']}"
+        if e.get("cat") == "transfer" and extra.get("bytes"):
+            # Derived wire attrs on transfer spans: effective
+            # throughput and codec ratio read directly off the slice.
+            dur = max(1e-9, e["end"] - e["start"])
+            extra = dict(extra)
+            extra["mbps"] = round(extra["bytes"] / dur / 1e6, 2)
+            if extra.get("wire_bytes"):
+                extra["wire_ratio"] = round(
+                    extra["wire_bytes"] / extra["bytes"], 3)
         out.append({
             "cat": e.get("cat", ""),
             "name": e.get("name", ""),

@@ -29,7 +29,14 @@ from typing import Callable, Dict, Optional
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
+_U32 = struct.Struct("<I")
 PICKLE_PROTOCOL = 5
+
+# Frame-length top bit marks an out-of-band frame: a small pickled
+# message followed by one raw payload buffer that is NEVER copied
+# through pickle on either side (the data plane's chunk bytes). Layout:
+#   u64 (body_len | OOB)  |  u32 meta_len | meta | payload...
+_OOB_FLAG = 1 << 63
 
 TCP_PREFIX = "tcp://"
 
@@ -71,22 +78,70 @@ class ConnectionClosed(Exception):
 
 
 def _send_msg(sock: socket.socket, payload: bytes) -> None:
-    sock.sendall(_LEN.pack(len(payload)) + payload)
+    header = _LEN.pack(len(payload))
+    if len(payload) >= 1 << 16:
+        # Scatter-gather: concatenating the length prefix onto a
+        # multi-MB chunk payload costs a full copy per message on the
+        # data plane's hot path.
+        _sendmsg_all(sock, [header, payload])
+    else:
+        sock.sendall(header + payload)
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        b = sock.recv(min(n, 1 << 20))
-        if not b:
+def _sendmsg_all(sock: socket.socket, parts) -> None:
+    mvs = [memoryview(p).cast("B") for p in parts]
+    while mvs:
+        sent = sock.sendmsg(mvs)
+        while sent > 0 and mvs:
+            if sent >= mvs[0].nbytes:
+                sent -= mvs[0].nbytes
+                mvs.pop(0)
+            else:
+                mvs[0] = mvs[0][sent:]
+                sent = 0
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    # recv_into a single pre-sized buffer: no per-recv allocations and
+    # no join copy (pickle.loads accepts the bytearray directly).
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if not r:
             raise ConnectionClosed()
-        chunks.append(b)
-        n -= len(b)
-    return b"".join(chunks)
+        got += r
+    return buf
 
 
-def _recv_msg(sock: socket.socket) -> bytes:
+def _send_msg_oob(sock: socket.socket, meta: bytes, payload) -> None:
+    """One frame: pickled meta + a raw payload buffer, scatter-gathered
+    so the payload is handed to the kernel without ever being copied
+    into a pickle stream or onto a header."""
+    pv = memoryview(payload).cast("B")
+    body_len = _U32.size + len(meta) + pv.nbytes
+    _sendmsg_all(sock, [_LEN.pack(body_len | _OOB_FLAG),
+                        _U32.pack(len(meta)), meta, pv])
+
+
+def _decode_oob(body: bytearray) -> dict:
+    """Inverse of _send_msg_oob: the message dict gets the payload as a
+    zero-copy memoryview over the receive buffer under `data`."""
+    mv = memoryview(body)
+    (meta_len,) = _U32.unpack_from(mv, 0)
+    pos = _U32.size + meta_len
+    msg = pickle.loads(mv[_U32.size:pos])
+    msg["data"] = mv[pos:]
+    return msg
+
+
+def _recv_msg(sock: socket.socket):
+    """Returns the frame payload: a bytearray (plain pickled message)
+    or an already-decoded dict (out-of-band frame)."""
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n & _OOB_FLAG:
+        return _decode_oob(_recv_exact(sock, n & ~_OOB_FLAG))
     return _recv_exact(sock, n)
 
 
@@ -138,7 +193,12 @@ class Connection:
         self._thread.start()
 
     # -- sending ---------------------------------------------------------
-    def send(self, msg: dict) -> None:
+    def send(self, msg: dict, buffer=None) -> None:
+        """Ship one message. `buffer` (bytes-like) rides the frame
+        OUT-OF-BAND: it is scatter-gathered straight from the caller's
+        memory to the socket and surfaces at the receiver as a
+        zero-copy view under `msg["data"]` — the data plane's chunk
+        payloads never pass through pickle on either side."""
         hooks = _serialize_hooks
         if hooks is not None:
             hooks[0]()
@@ -150,7 +210,10 @@ class Connection:
             payload = pickle.dumps(msg, protocol=PICKLE_PROTOCOL)
         try:
             with self._send_lock:
-                _send_msg(self.sock, payload)
+                if buffer is not None:
+                    _send_msg_oob(self.sock, payload, buffer)
+                else:
+                    _send_msg(self.sock, payload)
         except (OSError, ConnectionClosed) as e:
             self._handle_close()
             raise ConnectionClosed(str(e)) from e
@@ -184,7 +247,8 @@ class Connection:
         try:
             while True:
                 payload = _recv_msg(self.sock)
-                msg = pickle.loads(payload)
+                msg = payload if isinstance(payload, dict) \
+                    else pickle.loads(payload)
                 if msg.get("kind") == "reply":
                     fut = self._pending.get(msg["reply_to"])
                     if fut is not None:
@@ -250,6 +314,14 @@ class Server:
             self._sock.bind(path)
         self._sock.listen(256)
         self.connections: Dict[str, Connection] = {}
+        # Striped data plane: peers may open EXTRA connections for bulk
+        # object transfer (hello carries `transfer: True`). They speak
+        # the same framed protocol but are kept out of `connections` —
+        # keying them by peer addr would shadow the peer's control
+        # connection, and their lifecycle (a pool conn dying is a
+        # transfer retry, not a peer death) must not trigger the
+        # server's on_close peer-cleanup.
+        self.transfer_connections: list = []
         self._lock = threading.Lock()
         self._stopped = False
         self._thread = threading.Thread(
@@ -275,11 +347,24 @@ class Server:
         except Exception:
             sock.close()
             return
+        if hello.get("transfer"):
+            conn = Connection(sock, self.handler, peer_addr,
+                              on_close=self._on_transfer_conn_close)
+            with self._lock:
+                self.transfer_connections.append(conn)
+            return
         conn = Connection(sock, self.handler, peer_addr, on_close=self._on_conn_close)
         with self._lock:
             self.connections[peer_addr] = conn
         if self.on_connect is not None:
             self.on_connect(conn, hello)
+
+    def _on_transfer_conn_close(self, conn: Connection):
+        with self._lock:
+            try:
+                self.transfer_connections.remove(conn)
+            except ValueError:
+                pass
 
     def _on_conn_close(self, conn: Connection):
         with self._lock:
@@ -295,7 +380,8 @@ class Server:
         except OSError:
             pass
         with self._lock:
-            conns = list(self.connections.values())
+            conns = list(self.connections.values()) \
+                + list(self.transfer_connections)
         for c in conns:
             c.close()
         if not is_tcp(self.path) and os.path.exists(self.path):

@@ -46,9 +46,398 @@ from .task_spec import (ACTOR_CREATION_TASK, ACTOR_TASK, NORMAL_TASK, ArgSpec,
 
 logger = logging.getLogger(__name__)
 
-# Inter-node transfers stream in chunks of this size (reference: the
-# ObjectManager's chunked Push/Pull, `object_manager.h:183-189`).
+# Default inter-node chunk size (reference: the ObjectManager's chunked
+# Push/Pull, `object_manager.h:183-189`); tunable via
+# RAY_TPU_OBJECT_CHUNK_SIZE. Large objects additionally split so every
+# transfer stream gets work (see Runtime._transfer_chunk_size).
 OBJECT_CHUNK_SIZE = 8 * 1024 * 1024
+
+# Floor for stripe chunks: below this the per-message framing overhead
+# outweighs stream parallelism.
+STRIPE_CHUNK_MIN = 256 * 1024
+
+
+class _SendTicket:
+    """Completion tracking for one striped object send: counts
+    outstanding chunk dispatches, collects failed items for redispatch
+    over the surviving streams, and accumulates wire accounting."""
+
+    def __init__(self, oid, num: int, total: int, encoder):
+        self.oid = oid
+        self.num = num
+        self.total = total
+        self.encoder = encoder
+        self.wire_bytes = 0
+        self.raw_bytes = 0
+        self._cv = threading.Condition()
+        self._outstanding = 0
+        self.failed: list = []
+        self.exc: Optional[BaseException] = None
+
+    def dispatching(self):
+        with self._cv:
+            self._outstanding += 1
+
+    def done(self, raw_n: int, wire_n: int):
+        with self._cv:
+            self._outstanding -= 1
+            self.raw_bytes += raw_n
+            self.wire_bytes += wire_n
+            self._cv.notify_all()
+
+    def fail(self, item, exc: BaseException):
+        with self._cv:
+            self._outstanding -= 1
+            self.failed.append(item)
+            self.exc = exc
+            self._cv.notify_all()
+
+    def drain_failures(self) -> list:
+        """Block until no dispatches are in flight; returns (and clears)
+        the items that need redispatch."""
+        with self._cv:
+            while self._outstanding:
+                self._cv.wait()
+            out, self.failed = self.failed, []
+            return out
+
+
+class _StripeWorker:
+    """One transfer connection + its sender thread. Items are
+    (ticket, index, offset, raw_chunk); the worker encodes (codec runs
+    off the caller's thread, in parallel across streams) and ships. A
+    send failure marks the worker dead and hands every affected item
+    back to its ticket for redispatch on the remaining streams."""
+
+    __slots__ = ("pool", "conn", "q", "alive", "thread", "owns_conn")
+
+    def __init__(self, pool: "_TransferPool", conn, owns_conn=True):
+        self.pool = pool
+        self.conn = conn
+        # False for the single-stream fallback worker riding the peer's
+        # CONTROL connection: the pool must never close that.
+        self.owns_conn = owns_conn
+        self.q: "queue.Queue" = queue.Queue(maxsize=4)
+        self.alive = True
+        self.thread = threading.Thread(
+            target=self._loop, daemon=True, name="stripe-send")
+        self.thread.start()
+
+    def _loop(self):
+        while True:
+            item = self.q.get()
+            if item is None:
+                return
+            ticket = item[0]
+            try:
+                raw_n, wire_n = self.pool._send_item(self.conn, item)
+                ticket.done(raw_n, wire_n)
+            except Exception as e:
+                self.alive = False
+                ticket.fail(item, e)
+                # Hand back everything already queued behind the failure.
+                while True:
+                    try:
+                        it = self.q.get_nowait()
+                    except queue.Empty:
+                        break
+                    if it is not None:
+                        it[0].fail(it, e)
+                if self.owns_conn:
+                    try:
+                        self.conn.close()
+                    except Exception:
+                        pass
+                return
+
+    def stop(self):
+        self.alive = False
+        try:
+            self.q.put_nowait(None)
+        except queue.Full:
+            pass
+        if self.owns_conn:
+            try:
+                self.conn.close()
+            except Exception:
+                pass
+
+
+class _TransferPool:
+    """Striped, compressed data plane to ONE peer.
+
+    The r5 wire shipped every chunk of every object through the peer's
+    single control connection: one large object serialized behind one
+    sendall, and concurrent fetches of different objects queued head-of-
+    line (BENCH_r05: the full-frame Sebulba line demanded 144% of the
+    single stream). This pool opens up to RAY_TPU_TRANSFER_STREAMS extra
+    connections (hello `transfer: True`; the peer's server keeps them
+    out of its control-connection table) and stripes chunk messages
+    across them by blob offset, so streams proceed in parallel and land
+    out of order into the receiver's offset-addressed destination.
+
+    Chunks are wire-compressed per the StreamEncoder policy (first-chunk
+    incompressibility probe, per-chunk codec flag, link-rate gate in
+    auto mode). A stream dying mid-object redispatches its chunks over
+    the survivors; only when every stream AND the control connection are
+    gone does the transfer abort (the receiver discards the partial
+    object and retries/fails its fetch cleanly).
+    """
+
+    def __init__(self, runtime: "Runtime", addr: str):
+        self._rt = runtime
+        self.addr = addr
+        self._lock = threading.Lock()
+        self._workers: List[_StripeWorker] = []
+        self._target = max(0, config.get("RAY_TPU_TRANSFER_STREAMS"))
+        self._dial_fail_until = 0.0
+        self._closed = False
+        self.active = 0          # objects currently streaming
+        self.ema_mbps: Optional[float] = None
+        # Held by at most one UNCONTENDED small-object send at a time:
+        # lets the common case (one or two chunks, nobody else
+        # streaming to this peer) skip the worker handoff entirely —
+        # on small boxes every thread hop costs scheduler latency.
+        # Contended senders take the worker path, so the r5 lock-convoy
+        # of many threads on one connection cannot re-form.
+        self._inline_mutex = threading.Lock()
+
+    # -- connections ---------------------------------------------------
+    def _ensure_workers(self) -> List[_StripeWorker]:
+        with self._lock:
+            self._workers = [w for w in self._workers if w.alive]
+            if self._target < 2:
+                # Single-stream mode still funnels chunk sends through
+                # ONE dedicated sender thread (over the control
+                # connection): concurrent send_objects contending on
+                # the connection's send lock convoy badly on small
+                # boxes.
+                if not self._workers and not self._closed:
+                    try:
+                        conn = self._rt._get_conn(self.addr)
+                    except Exception:
+                        return []
+                    self._workers.append(
+                        _StripeWorker(self, conn, owns_conn=False))
+                return list(self._workers)
+            need = self._target - len(self._workers)
+            if self._closed or need <= 0 \
+                    or time.monotonic() < self._dial_fail_until:
+                return list(self._workers)
+        dialed = []
+        for _ in range(need):
+            try:
+                conn = protocol.connect(
+                    self.addr, self._rt.addr, self._rt._handle,
+                    hello_extra={"transfer": True}, timeout=5.0)
+            except Exception:
+                with self._lock:
+                    self._dial_fail_until = time.monotonic() + 5.0
+                break
+            dialed.append(conn)
+        with self._lock:
+            if self._closed:
+                for c in dialed:
+                    c.close()
+                return []
+            for c in dialed:
+                self._workers.append(_StripeWorker(self, c))
+            return list(self._workers)
+
+    def close(self):
+        with self._lock:
+            self._closed = True
+            workers, self._workers = self._workers, []
+        for w in workers:
+            w.stop()
+
+    # -- sending -------------------------------------------------------
+    def _send_item(self, conn, item):
+        ticket, idx, offset, chunk = item
+        codec, payload = ticket.encoder.encode(chunk)
+        t0 = time.monotonic()
+        # Payload rides the frame out-of-band (protocol._send_msg_oob):
+        # straight from this buffer to the kernel, no pickle copy on
+        # either side.
+        conn.send({"kind": "object_chunk", "object_id": ticket.oid,
+                   "index": idx, "offset": offset,
+                   "num_chunks": ticket.num, "total": ticket.total,
+                   "codec": codec}, buffer=payload)
+        self._account(len(chunk), len(payload),
+                      time.monotonic() - t0, codec)
+        return len(chunk), len(payload)
+
+    def _account(self, raw_n: int, wire_n: int, dt: float, codec: int):
+        from . import metrics as metrics_mod
+        with self._lock:
+            if dt > 0:
+                mbps = wire_n / dt / 1e6
+                self.ema_mbps = mbps if self.ema_mbps is None \
+                    else 0.8 * self.ema_mbps + 0.2 * mbps
+        metrics_mod.inc("wire_bytes_on_wire", wire_n)
+        metrics_mod.inc("wire_bytes_raw", raw_n)
+        if codec != serialization.WIRE_RAW:
+            metrics_mod.inc("wire_bytes_saved", max(0, raw_n - wire_n))
+            metrics_mod.inc("wire_chunks_compressed")
+        else:
+            metrics_mod.inc("wire_chunks_raw")
+
+    def _dispatch(self, item):
+        """Queue one chunk on the least-loaded live stream; with no
+        streams (single-stream config, or every dial failed) ship
+        synchronously on the control connection. Raises on total
+        failure."""
+        ticket = item[0]
+        while True:
+            workers = self._ensure_workers()
+            workers = [w for w in workers if w.alive]
+            if not workers:
+                conn = self._rt._get_conn(self.addr)  # may raise
+                raw_n, wire_n = self._send_item(conn, item)
+                ticket.done(raw_n, wire_n)
+                return
+            best = min(workers, key=lambda w: w.q.qsize())
+            try:
+                best.q.put(item, timeout=0.2)
+                return
+            except queue.Full:
+                continue  # re-pick: load or liveness changed
+
+    def send_object(self, oid, parts, total: int, num: int) -> dict:
+        """Stream one object's serialized bytes to the peer. `parts`
+        yields raw chunks in offset order. Returns wire accounting for
+        the caller's trace span. Raises ConnectionClosed when the
+        object could not be fully delivered (an abort is sent so the
+        receiver never seals a partial object)."""
+        encoder = serialization.StreamEncoder(
+            mode=config.get("RAY_TPU_WIRE_COMPRESSION"),
+            min_ratio=config.get("RAY_TPU_WIRE_COMPRESSION_MIN_RATIO"),
+            link_mbps=self.ema_mbps,
+            max_link_mbps=config.get(
+                "RAY_TPU_WIRE_COMPRESSION_MAX_LINK_MBPS"))
+        ticket = _SendTicket(oid, num, total, encoder)
+        # The begin marker rides the control connection so any
+        # push_result sent there afterwards is ordered BEHIND it: the
+        # receiver then always knows a stripe stream is pending and
+        # defers the result until its seal.
+        control = self._rt._get_conn(self.addr)
+        control.send(
+            {"kind": "transfer_begin", "object_id": oid,
+             "total": total, "num_chunks": num})
+        with self._lock:
+            self.active += 1
+        try:
+            if num <= 2 and self._inline_mutex.acquire(blocking=False):
+                # Uncontended small send: synchronous on the control
+                # connection, zero thread handoffs.
+                try:
+                    return self._send_inline(control, ticket, parts)
+                finally:
+                    self._inline_mutex.release()
+            offset = 0
+            first = True
+            for idx, chunk in enumerate(parts):
+                if first:
+                    # Probe BEFORE fan-out: encode() then runs
+                    # lock-free on the worker threads.
+                    encoder.probe(chunk)
+                    first = False
+                ticket.dispatching()
+                try:
+                    self._dispatch((ticket, idx, offset, chunk))
+                except Exception as e:
+                    ticket.done(0, 0)  # undo the dispatch count
+                    self._abort(oid)
+                    raise protocol.ConnectionClosed(str(e)) from e
+                offset += len(chunk)
+            # Redispatch chunks whose stream died over the survivors.
+            for _ in range(max(2, self._target + 1)):
+                failed = ticket.drain_failures()
+                if not failed:
+                    break
+                from . import metrics as metrics_mod
+                metrics_mod.inc("wire_stripe_retries", len(failed))
+                try:
+                    for item in failed:
+                        ticket.dispatching()
+                        self._dispatch(item)
+                except Exception as e:
+                    ticket.done(0, 0)
+                    self._abort(oid)
+                    raise protocol.ConnectionClosed(str(e)) from e
+            else:
+                self._abort(oid)
+                raise protocol.ConnectionClosed(
+                    f"striped transfer of {oid.hex()[:16]} to "
+                    f"{self.addr} kept failing: {ticket.exc!r}")
+            if ticket.failed:
+                self._abort(oid)
+                raise protocol.ConnectionClosed(
+                    f"striped transfer of {oid.hex()[:16]} to "
+                    f"{self.addr} failed: {ticket.exc!r}")
+            with self._lock:
+                streams = len(self._workers)
+            return {"wire_bytes": ticket.wire_bytes,
+                    "bytes_saved": max(
+                        0, ticket.raw_bytes - ticket.wire_bytes),
+                    "streams": max(1, streams)}
+        finally:
+            with self._lock:
+                self.active -= 1
+
+    def _send_inline(self, conn, ticket: "_SendTicket", parts) -> dict:
+        """Synchronous chunk sends for the uncontended small-object
+        fast path (caller holds _inline_mutex)."""
+        offset = 0
+        for idx, chunk in enumerate(parts):
+            if idx == 0:
+                ticket.encoder.probe(chunk)
+            ticket.dispatching()
+            try:
+                raw_n, wire_n = self._send_item(conn, (ticket, idx,
+                                                       offset, chunk))
+                ticket.done(raw_n, wire_n)
+            except Exception as e:
+                ticket.done(0, 0)
+                self._abort(ticket.oid)
+                raise protocol.ConnectionClosed(str(e)) from e
+            offset += len(chunk)
+        return {"wire_bytes": ticket.wire_bytes,
+                "bytes_saved": max(
+                    0, ticket.raw_bytes - ticket.wire_bytes),
+                "streams": 1}
+
+    def _abort(self, oid):
+        """Tell the receiver to discard its partial object (best
+        effort: when even the control connection is gone the receiver's
+        own liveness/retry logic cleans up)."""
+        try:
+            self._rt._get_conn(self.addr).send(
+                {"kind": "object_chunk_abort", "object_id": oid})
+        except Exception:
+            pass
+
+
+class _InboundTransfer:
+    """Receiver-side state of one striped inbound object: stripes
+    pwrite straight into the pre-sized store destination keyed by blob
+    offset — this buffer holds bookkeeping (received indices, wire
+    accounting), never chunk bytes."""
+
+    __slots__ = ("total", "num", "received", "dest", "t0", "owner_ref",
+                 "retries", "pending_push", "wire_bytes", "raw_bytes")
+
+    def __init__(self, t0: float):
+        self.total: Optional[int] = None
+        self.num: Optional[int] = None
+        self.received: Set[int] = set()
+        self.dest = None
+        self.t0 = t0
+        self.owner_ref: Optional[ObjectRef] = None  # set on pulls
+        self.retries = 0
+        self.pending_push: Optional[dict] = None
+        self.wire_bytes = 0
+        self.raw_bytes = 0
 
 
 class _RefTracker:
@@ -405,12 +794,21 @@ class Runtime:
             object_ref_mod.begin_export_collection,
             self._finish_export_collection)
         self.ref_tracker = _RefTracker(self)
-        # In-flight inbound chunked transfers: oid -> {total, chunks}.
-        self._chunk_buf: Dict[ObjectID, dict] = {}
+        # In-flight inbound striped transfers: oid -> _InboundTransfer
+        # (offsets and bookkeeping only; stripe bytes pwrite directly
+        # into the pre-sized store destination).
+        self._chunk_buf: Dict[ObjectID, _InboundTransfer] = {}
         self._chunk_lock = threading.Lock()
+        self._chunk_size = int(config.get("RAY_TPU_OBJECT_CHUNK_SIZE"))
+        self._stripe_min = int(config.get("RAY_TPU_WIRE_STRIPE_MIN"))
 
         self._conns: Dict[str, protocol.Connection] = {}
         self._conns_lock = threading.Lock()
+        # Striped data plane, one pool of transfer connections per peer.
+        self._transfer_pools: Dict[str, _TransferPool] = {}
+        # Bounded parallel-fetch executor for multi-ref get()/wait().
+        self._fetch_pool: Optional[ThreadPoolExecutor] = None
+        self._fetch_lock = threading.Lock()
         self._fn_cache: Dict[str, object] = {}
         self._exported: Set[str] = set()
         self._export_lock = threading.Lock()
@@ -709,8 +1107,36 @@ class Runtime:
         if single:
             refs = [refs]
         deadline = None if timeout is None else time.monotonic() + timeout
+        if len(refs) > 1:
+            # Issue owner fetches for every pending foreign ref up
+            # front (bounded by the prefetch pool) so transfers overlap
+            # instead of serializing through the one-at-a-time loop.
+            self._prefetch(refs)
         values = [self._get_one(r, deadline) for r in refs]
         return values[0] if single else values
+
+    def _fetch_submit(self, ref: ObjectRef) -> bool:
+        """Queue an owner fetch on the bounded prefetch executor.
+        Returns False when a fetch for this object is already in
+        flight."""
+        with self._fetch_lock:
+            if ref.id in self._fetching:
+                return False
+            self._fetching.add(ref.id)
+            if self._fetch_pool is None:
+                self._fetch_pool = ThreadPoolExecutor(
+                    max_workers=max(1, config.get("RAY_TPU_GET_PREFETCH")),
+                    thread_name_prefix="obj-fetch")
+            pool = self._fetch_pool
+        pool.submit(self._request_from_owner, ref)
+        return True
+
+    def _prefetch(self, refs: List[ObjectRef]) -> None:
+        for r in refs:
+            if (r.owner_addr and r.owner_addr != self.addr
+                    and not self.memory.contains(r.id)
+                    and not self.shm.contains(r.id)):
+                self._fetch_submit(r)
 
     def _remaining(self, deadline) -> Optional[float]:
         if deadline is None:
@@ -739,7 +1165,12 @@ class Runtime:
 
     def _get_one(self, ref: ObjectRef, deadline):
         owner_is_self = not ref.owner_addr or ref.owner_addr == self.addr
-        requested = False
+        # A prefetch in flight (multi-ref get/wait) or an inbound
+        # stripe stream already landing counts as the initial request
+        # — a duplicate get_object would make the owner stream the
+        # whole object twice. Liveness re-asks below still apply.
+        requested = ref.id in self._fetching \
+            or ref.id in self._chunk_buf
         stale_probes = 0
         chunk_progress = -1
         lost_retries = 2
@@ -783,10 +1214,10 @@ class Runtime:
             if self.shm.contains(ref.id):
                 continue  # sealed without a notification: loop picks it up
             if not owner_is_self:
-                # A chunked transfer that is still advancing is healthy.
+                # A striped transfer that is still advancing is healthy.
                 with self._chunk_lock:
                     buf = self._chunk_buf.get(ref.id)
-                    parts = len(buf["parts"]) if buf else -1
+                    parts = len(buf.received) if buf else -1
                 if parts >= 0 and parts != chunk_progress:
                     chunk_progress = parts
                     continue
@@ -855,8 +1286,9 @@ class Runtime:
         # chunk stream races this thread's reply handling).
         t_req = time.time()
         with self._chunk_lock:
-            self._chunk_buf.setdefault(
-                ref.id, {"num": None, "parts": {}, "t0": t_req})
+            entry = self._chunk_buf.setdefault(
+                ref.id, _InboundTransfer(t_req))
+            entry.owner_ref = ref  # lets an aborted stripe retry itself
         status = None
         try:
             try:
@@ -894,31 +1326,37 @@ class Runtime:
                 self.memory.put(ref.id, _Cell("error", ObjectLostError(
                     f"object {ref.id.hex()[:16]} was lost")))
             # 'pending': owner will push_result when sealed.
-            # 'chunked': object_chunk messages follow on this connection;
-            # the chunk handler seals into the local store when complete.
+            # 'chunked': object_chunk stripes follow on the owner's
+            # transfer connections (and/or the control connection); the
+            # chunk handler seals into the local store when complete.
+            elif status == "chunked":
+                with self._chunk_lock:
+                    e = self._chunk_buf.get(ref.id)
+                    if e is not None and e.total is None:
+                        e.total = reply["total"]
+                        e.num = reply["num_chunks"]
         finally:
             if status != "chunked":
                 # Drop the pre-registered transfer-start entry (only a
-                # chunk stream consumes it) — also on the error paths.
+                # stripe stream consumes it) — also on the error paths —
+                # unless stripes already started landing on a transfer
+                # connection (they can race this control-plane reply).
                 with self._chunk_lock:
                     buf = self._chunk_buf.get(ref.id)
-                    if buf is not None and not buf["parts"]:
+                    if buf is not None and not buf.received \
+                            and buf.total is None:
                         del self._chunk_buf[ref.id]
-            self._fetching.discard(ref.id)
+            with self._fetch_lock:
+                self._fetching.discard(ref.id)
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[list, list]:
         if num_returns > len(refs):
             raise ValueError("num_returns exceeds number of refs")
         deadline = None if timeout is None else time.monotonic() + timeout
-        # Kick off fetches for borrowed refs so readiness can become local.
-        for r in refs:
-            if (r.owner_addr and r.owner_addr != self.addr
-                    and not self.memory.contains(r.id)
-                    and r.id not in self._fetching):
-                self._fetching.add(r.id)
-                threading.Thread(target=self._request_from_owner, args=(r,),
-                                 daemon=True).start()
+        # Kick off fetches for borrowed refs so readiness can become
+        # local (bounded-parallel, shared with get()'s prefetch window).
+        self._prefetch(refs)
         # Event-driven: every push_result put() wakes the memory-store cv
         # (reference: CoreWorker::Wait blocks on store callbacks rather
         # than polling, core_worker.cc:258). The id list keeps duplicates
@@ -1441,6 +1879,17 @@ class Runtime:
                 with self._owned_lock:
                     metrics_mod.set_gauge("owned_objects",
                                           float(len(self._owned)))
+                # Data-plane gauges (tentpole): stripes in flight and
+                # the per-peer wire-throughput EMA summed over peers
+                # (the per_node breakdown keeps them attributable).
+                with self._conns_lock:
+                    pools = list(self._transfer_pools.values())
+                metrics_mod.set_gauge(
+                    "wire_stripes_active",
+                    float(sum(p.active for p in pools)))
+                metrics_mod.set_gauge(
+                    "wire_send_mbps",
+                    float(sum(p.ema_mbps or 0.0 for p in pools)))
                 snap = metrics_mod.snapshot()
                 self.head.send({"kind": "metrics_push",
                                 "node": self.node_id,
@@ -1486,6 +1935,9 @@ class Runtime:
         with self._conns_lock:
             if self._conns.get(conn.peer_addr) is conn:
                 del self._conns[conn.peer_addr]
+            pool = self._transfer_pools.pop(conn.peer_addr, None)
+        if pool is not None:
+            pool.close()
         self._drop_peer_pins(conn.peer_addr)
         self._fail_pending_for_addr(conn.peer_addr)
         with self._lease_lock:
@@ -1530,6 +1982,10 @@ class Runtime:
             self._on_push_task(msg["spec"])
         elif kind == "object_chunk":
             self._on_object_chunk(msg)
+        elif kind == "transfer_begin":
+            self._on_transfer_begin(msg)
+        elif kind == "object_chunk_abort":
+            self._on_chunk_abort(msg)
         elif kind == "msg_batch":
             for m in msg["msgs"]:
                 self._handle(conn, m)
@@ -1568,6 +2024,17 @@ class Runtime:
 
     def _on_push_result(self, msg: dict):
         oid: ObjectID = msg["object_id"]
+        if msg.get("in_shm") and not self.shm.contains(oid):
+            # The striped transfer behind this result may still be
+            # landing (stripes ride separate transfer connections; only
+            # the transfer_begin marker is ordered ahead of this
+            # message on the control connection). Park the result on
+            # the inbound entry; the seal/abort path re-delivers it.
+            with self._chunk_lock:
+                entry = self._chunk_buf.get(oid)
+                if entry is not None and entry.pending_push is None:
+                    entry.pending_push = msg
+                    return
         if msg.get("error") is not None:
             cell = _Cell("error", msg["error"])
         elif msg.get("in_shm"):
@@ -1669,64 +2136,173 @@ class Runtime:
         else:
             conn.reply(msg, status="lost")
 
+    def _transfer_chunk_size(self, size: int) -> int:
+        """Stripe chunking: split so every transfer stream gets work,
+        but never below the framing-overhead floor nor above the
+        configured chunk cap."""
+        streams = max(1, config.get("RAY_TPU_TRANSFER_STREAMS"))
+        chunk = max(STRIPE_CHUNK_MIN, (size + streams - 1) // streams)
+        return min(chunk, self._chunk_size)
+
+    def _get_transfer_pool(self, addr: str) -> _TransferPool:
+        with self._conns_lock:
+            pool = self._transfer_pools.get(addr)
+            if pool is None:
+                pool = _TransferPool(self, addr)
+                self._transfer_pools[addr] = pool
+            return pool
+
+    def _stream_object(self, addr: str, oid: ObjectID, parts,
+                       total: int, num: int) -> None:
+        """Single protocol point for all outbound transfer paths:
+        stripe the chunk iterator across the peer's transfer pool and
+        record the sender-side transfer span."""
+        t0 = time.time()
+        acct = self._get_transfer_pool(addr).send_object(
+            oid, parts, total, num)
+        self.profiler.record(
+            "transfer", f"push {oid.hex()[:12]}", t0, time.time(),
+            {"bytes": total, "chunks": num, "peer": addr, **acct,
+             "flow_id": oid.task_id().hex(), "flow": "t"})
+
     def _reply_blob(self, conn: protocol.Connection, msg: dict,
                     oid: ObjectID):
         """Ship a shared-store object to a peer on another node: one
-        message when small, a chunk stream read incrementally from the
-        sealed file when large — the whole blob is never materialized
-        (reference: ObjectManager chunked Push, `object_manager.h:183`)."""
+        message when small, a striped chunk stream read incrementally
+        from the sealed file when large — the whole blob is never
+        materialized (reference: ObjectManager chunked Push,
+        `object_manager.h:183`)."""
         size = self.shm.blob_size(oid)
         if size is None:
             self._reply_lost_or_reconstruct(conn, msg, oid)
             return
-        if size <= OBJECT_CHUNK_SIZE:
+        if size <= self._stripe_min:
             blob = self.shm.read_blob(oid)
             if blob is None:
                 self._reply_lost_or_reconstruct(conn, msg, oid)
                 return
             conn.reply(msg, status="blob", data=blob)
             return
-        num = (size + OBJECT_CHUNK_SIZE - 1) // OBJECT_CHUNK_SIZE
+        chunk = self._transfer_chunk_size(size)
+        num = (size + chunk - 1) // chunk
         conn.reply(msg, status="chunked", total=size, num_chunks=num)
 
         def stream():
             try:
-                self._stream_chunks(
-                    conn, oid,
-                    self.shm.read_blob_chunks(oid, OBJECT_CHUNK_SIZE), num)
-            except protocol.ConnectionClosed:
+                self._stream_object(
+                    conn.peer_addr, oid,
+                    self.shm.read_blob_chunks(oid, chunk), size, num)
+            except (protocol.ConnectionClosed, OSError):
                 pass
-        threading.Thread(target=stream, daemon=True,
-                         name="object-chunk-send").start()
+        if num <= 4:
+            # Few chunks: stream inline from this (recv-loop) thread —
+            # the worker-pool dispatch absorbs them without blocking,
+            # and skipping the thread spawn saves a scheduler hop per
+            # object (r5's blob reply was likewise built inline).
+            stream()
+        else:
+            threading.Thread(target=stream, daemon=True,
+                             name="object-stripe-send").start()
+
+    def _on_transfer_begin(self, msg: dict):
+        """Announce of an inbound striped transfer (ordered ahead of
+        any push_result for the same object on the control
+        connection)."""
+        with self._chunk_lock:
+            entry = self._chunk_buf.setdefault(
+                msg["object_id"], _InboundTransfer(time.time()))
+            if entry.total is None:
+                entry.total = msg["total"]
+                entry.num = msg["num_chunks"]
 
     def _on_object_chunk(self, msg: dict):
         oid: ObjectID = msg["object_id"]
+        # Decode on THIS connection's recv thread: decompression of
+        # stripes on different transfer connections runs in parallel
+        # (zlib/lz4 release the GIL).
+        data = serialization.wire_decode(msg.get("codec", 0),
+                                         msg["data"])
         with self._chunk_lock:
             # Requester-initiated pulls pre-register t0 at request time
             # (full round-trip span); PUSHED streams (task results)
             # start at first-chunk arrival — receive-to-seal is the
             # best locally-observable window (sender clocks differ).
-            buf = self._chunk_buf.setdefault(
-                oid, {"num": None, "parts": {}, "t0": time.time()})
-            if buf["num"] is None:
-                buf["num"] = msg["num_chunks"]
-            buf["parts"][msg["index"]] = msg["data"]
-            done = len(buf["parts"]) == buf["num"]
-            if done:
-                parts = [buf["parts"][i] for i in range(buf["num"])]
-                t0 = buf["t0"]
+            entry = self._chunk_buf.setdefault(
+                oid, _InboundTransfer(time.time()))
+            if entry.total is None:
+                entry.total = msg["total"]
+                entry.num = msg["num_chunks"]
+            if msg["index"] in entry.received:
+                return  # duplicate (overlapping retry stream)
+            if entry.dest is None:
+                entry.dest = self.shm.create_receive(oid, entry.total)
+            dest = entry.dest
+        # Offset-addressed landing outside the lock: stripes arriving
+        # out of order on different connections pwrite concurrently
+        # into the pre-sized destination — no assembly copy.
+        dest.write_at(msg["offset"], data)
+        with self._chunk_lock:
+            if msg["index"] in entry.received:
+                return  # concurrent duplicate from an overlapping retry
+            entry.received.add(msg["index"])
+            entry.wire_bytes += len(msg["data"])
+            entry.raw_bytes += len(data)
+            done = entry.num is not None \
+                and len(entry.received) >= entry.num
+            if done and self._chunk_buf.get(oid) is entry:
                 del self._chunk_buf[oid]
         if done:
-            self.shm.put_blob(oid, parts)
+            entry.dest.seal()
             self.memory.put(oid, _Cell("shm"))
+            from . import metrics as metrics_mod
+            metrics_mod.inc("wire_bytes_recv", entry.wire_bytes)
+            saved = max(0, entry.raw_bytes - entry.wire_bytes)
             # Object-transfer timeline (parity: the reference's
             # transfer dump, `state.py:744`): one span per inbound
-            # chunked transfer, sized.
+            # striped transfer, sized, with wire accounting.
             self.profiler.record(
-                "transfer", f"pull {oid.hex()[:12]}", t0, time.time(),
-                {"bytes": sum(len(p) for p in parts),
-                 "chunks": len(parts),
+                "transfer", f"pull {oid.hex()[:12]}", entry.t0,
+                time.time(),
+                {"bytes": entry.raw_bytes, "chunks": entry.num,
+                 "wire_bytes": entry.wire_bytes, "bytes_saved": saved,
                  "flow_id": oid.task_id().hex(), "flow": "t"})
+            # Join the data-plane bytes onto the producing task's
+            # record (attr-only annotation; no state transition).
+            self.task_events.record(
+                oid.task_id(), task_events.ANNOTATE,
+                wire_bytes=entry.wire_bytes,
+                transfer_bytes=entry.raw_bytes)
+            if entry.pending_push is not None:
+                self._on_push_result(entry.pending_push)
+
+    def _on_chunk_abort(self, msg: dict):
+        """The sender lost every stream mid-object: discard the partial
+        destination (it never surfaces) and retry the fetch when we
+        initiated it, else fail it cleanly."""
+        oid: ObjectID = msg["object_id"]
+        with self._chunk_lock:
+            entry = self._chunk_buf.pop(oid, None)
+        if entry is None:
+            return
+        if entry.dest is not None:
+            entry.dest.abort()
+        ref = entry.owner_ref
+        if ref is not None and entry.retries < 2:
+            with self._chunk_lock:
+                ne = self._chunk_buf.setdefault(
+                    oid, _InboundTransfer(time.time()))
+                ne.owner_ref = ref
+                ne.retries = entry.retries + 1
+            self._fetch_submit(ref)
+        elif entry.pending_push is not None:
+            # Pushed result whose stream died: deliver the result
+            # message; the dangling-cell recovery in get() re-asks /
+            # reconstructs.
+            self._on_push_result(entry.pending_push)
+        elif ref is not None:
+            self.memory.put(oid, _Cell("error", ObjectLostError(
+                f"striped transfer of {oid.hex()[:16]} from "
+                f"{ref.owner_addr} failed after retries")))
 
     def _on_publish(self, msg: dict):
         channel = msg["channel"]
@@ -1807,20 +2383,20 @@ class Runtime:
                 self.shm.create_and_seal(oid, meta, buffers, total)
                 msg["in_shm"] = True
             elif total > INLINE_OBJECT_MAX:
-                # Cross-node result: stream the blob to the owner's node
-                # in chunks WITHOUT materializing it (a multi-GB result
-                # must not double this worker's memory); the ordered
-                # push_result behind the chunks finds it sealed there.
-                num = max(1, (total + OBJECT_CHUNK_SIZE - 1)
-                          // OBJECT_CHUNK_SIZE)
+                # Cross-node result: stripe the blob to the owner's node
+                # WITHOUT materializing it (a multi-GB result must not
+                # double this worker's memory); the push_result behind
+                # it (ordered after the transfer_begin marker) is
+                # parked by the receiver until the stripes seal.
+                chunk = self._transfer_chunk_size(total)
+                num = max(1, (total + chunk - 1) // chunk)
                 try:
-                    self._stream_chunks(
-                        self._get_conn(addr), oid,
+                    self._stream_object(
+                        addr, oid,
                         serialization.iter_blob_chunks(
-                            meta, buffers, total, OBJECT_CHUNK_SIZE),
-                        num)
+                            meta, buffers, total, chunk), total, num)
                 except (protocol.ConnectionClosed, FileNotFoundError,
-                        ConnectionRefusedError):
+                        ConnectionRefusedError, OSError):
                     logger.warning("could not stream result %s to %s",
                                    oid, addr)
                 msg["in_shm"] = True
@@ -1830,38 +2406,30 @@ class Runtime:
                 msg["data"] = bytes(out)
         self._send_result(addr, msg, batch="in_shm" not in msg)
 
-    @staticmethod
-    def _stream_chunks(conn, oid: ObjectID, parts, num: int):
-        """Send an object's serialized bytes as ordered object_chunk
-        messages (single protocol point for all three transfer paths)."""
-        for i, part in enumerate(parts):
-            conn.send({"kind": "object_chunk", "object_id": oid,
-                       "index": i, "num_chunks": num, "data": part})
-
     def _send_blob_to(self, addr: str, oid: ObjectID, blob: bytes):
-        num = max(1, (len(blob) + OBJECT_CHUNK_SIZE - 1)
-                  // OBJECT_CHUNK_SIZE)
-        parts = (blob[i * OBJECT_CHUNK_SIZE:(i + 1) * OBJECT_CHUNK_SIZE]
-                 for i in range(num))
+        chunk = self._transfer_chunk_size(len(blob))
+        num = max(1, (len(blob) + chunk - 1) // chunk)
+        parts = (blob[i * chunk:(i + 1) * chunk] for i in range(num))
         try:
-            self._stream_chunks(self._get_conn(addr), oid, parts, num)
+            self._stream_object(addr, oid, parts, len(blob), num)
         except (protocol.ConnectionClosed, FileNotFoundError,
-                ConnectionRefusedError):
+                ConnectionRefusedError, OSError):
             logger.warning("could not stream object %s to %s", oid, addr)
 
     def _send_shm_to(self, addr: str, oid: ObjectID):
-        """Stream a sealed shared-store object to a cross-node peer,
+        """Stripe a sealed shared-store object to a cross-node peer,
         reading the file incrementally."""
         size = self.shm.blob_size(oid)
         if size is None:
             return
-        num = max(1, (size + OBJECT_CHUNK_SIZE - 1) // OBJECT_CHUNK_SIZE)
+        chunk = self._transfer_chunk_size(size)
+        num = max(1, (size + chunk - 1) // chunk)
         try:
-            self._stream_chunks(
-                self._get_conn(addr), oid,
-                self.shm.read_blob_chunks(oid, OBJECT_CHUNK_SIZE), num)
+            self._stream_object(
+                addr, oid, self.shm.read_blob_chunks(oid, chunk),
+                size, num)
         except (protocol.ConnectionClosed, FileNotFoundError,
-                ConnectionRefusedError):
+                ConnectionRefusedError, OSError):
             logger.warning("could not stream object %s to %s", oid, addr)
 
     def _send_result(self, addr: str, msg: dict, batch: bool = False):
@@ -2181,8 +2749,16 @@ class Runtime:
         except Exception:
             pass
         self.server.close()
+        with self._fetch_lock:
+            fetch_pool, self._fetch_pool = self._fetch_pool, None
+        if fetch_pool is not None:
+            fetch_pool.shutdown(wait=False)
         with self._conns_lock:
             conns = list(self._conns.values())
+            pools = list(self._transfer_pools.values())
+            self._transfer_pools.clear()
+        for p in pools:
+            p.close()
         # Close outside the lock: each close fires _on_peer_close, which
         # re-acquires _conns_lock.
         for c in conns:

@@ -24,6 +24,11 @@ RUNNING = "RUNNING"
 FINISHED = "FINISHED"
 FAILED = "FAILED"
 
+# Attr-only annotation: merges fields onto an existing record without a
+# state transition (the data plane joins its per-result transfer bytes
+# onto the producing task's record this way).
+ANNOTATE = "ANNOTATE"
+
 # Canonical ordering; late/out-of-order events never regress a record's
 # headline state (a driver's SUBMITTED flushing after the worker's
 # RUNNING must not roll the task back).
@@ -113,7 +118,20 @@ class TaskStateLog:
     def apply(self, ev: dict) -> None:
         tid = ev.get("task_id")
         state = ev.get("state")
-        if not tid or state not in _RANK:
+        if not tid:
+            return
+        if state == ANNOTATE:
+            # Attr-only: accumulate data-plane byte counters onto an
+            # existing record (a transfer for a task we never saw —
+            # ring-evicted or foreign — is dropped, not resurrected).
+            with self._lock:
+                rec = self._records.get(tid)
+                if rec is not None:
+                    for k in ("wire_bytes", "transfer_bytes"):
+                        if ev.get(k) is not None:
+                            rec[k] = rec.get(k, 0) + ev[k]
+            return
+        if state not in _RANK:
             return
         with self._lock:
             rec = self._records.get(tid)
@@ -146,6 +164,9 @@ class TaskStateLog:
         out = {k: rec[k] for k in ("task_id", "name", "kind", "state",
                                    "node", "worker_pid", "caller",
                                    "parent_task_id", "error")}
+        for k in ("wire_bytes", "transfer_bytes"):
+            if k in rec:
+                out[k] = rec[k]
         out["start"] = events[0][1] if events else None
         out["end"] = events[-1][1] \
             if events and rec["state"] in TERMINAL else None

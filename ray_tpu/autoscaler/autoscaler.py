@@ -7,11 +7,14 @@ driven by `monitor.py`). Policy:
 - scale UP toward the SHAPE of the unplaceable demand: the head's
   snapshot carries the pending work's resource vectors
   (`head.cluster_load` pending_demand), each vector is matched to the
-  first configured worker type that fits it, and that type is launched
+  SMALLEST configured worker type that fits it (fewest extraneous
+  resource kinds, then least capacity), and each type launches only as
+  many nodes as its assigned vectors PACK into (first-fit-decreasing)
   — a `{"GPUX": 1}` backlog launches GPUX nodes, a CPU backlog does
-  not (reference LoadMetrics tracks resource vectors for the same
-  reason, autoscaler.py:155,376). Demand no type can fit is logged,
-  never serviced by blind launches. Launches are bounded per tick by
+  not, and 6 x {CPU:1} against a CPU:4 type launches 2 nodes, not 6
+  (reference LoadMetrics tracks resource vectors for the same reason,
+  autoscaler.py:155,376). Demand no type can fit is logged, never
+  serviced by blind launches. Launches are bounded per tick by
   `max_launch_batch` and per type / globally by `max_workers`;
 - scale DOWN workers whose resources have been fully idle for
   `idle_timeout_s`, never below `min_workers`.
@@ -96,6 +99,40 @@ def _fits(node_resources: Dict[str, float],
           demand: Dict[str, float]) -> bool:
     return all(float(node_resources.get(k, 0.0)) >= float(v)
                for k, v in (demand or {}).items() if float(v) > 0)
+
+
+def _fit_preference(resources: Dict[str, float],
+                    demand: Dict[str, float]):
+    """Sort key for choosing among fitting types: fewest resource kinds
+    the demand doesn't ask for (don't burn a GPUX node on a CPU
+    vector), then smallest total capacity (least waste)."""
+    extraneous = sum(1 for k, v in resources.items()
+                     if float(v) > 0 and float(demand.get(k, 0.0)) <= 0)
+    return (extraneous, sum(float(v) for v in resources.values()))
+
+
+def _nodes_needed(node_resources: Dict[str, float],
+                  vectors: List[Dict[str, float]]) -> int:
+    """First-fit-decreasing packing: how many nodes of this shape the
+    pending vectors actually need. One vector per node was the r5
+    behavior — 6 x {CPU: 1} against a CPU:4 type launched 6 nodes for
+    work that fits on 2 (ADVICE r5 over-provisioning)."""
+    bins: List[Dict[str, float]] = []
+    for d in sorted(vectors,
+                    key=lambda v: -sum(float(x) for x in v.values())):
+        placed = False
+        for b in bins:
+            if _fits(b, d):
+                for k, v in d.items():
+                    b[k] = b.get(k, 0.0) - float(v)
+                placed = True
+                break
+        if not placed:
+            b = {k: float(v) for k, v in node_resources.items()}
+            for k, v in d.items():
+                b[k] = b.get(k, 0.0) - float(v)
+            bins.append(b)
+    return len(bins)
 
 
 class StandardAutoscaler:
@@ -207,39 +244,55 @@ class StandardAutoscaler:
         if not demand_vectors:
             return
 
-        # Demand-shape matching: pick the first type that fits each
-        # pending vector; launch per-type up to caps.
+        # Demand-shape matching: each pending vector goes to the
+        # SMALLEST fitting type (fewest extraneous resource kinds, then
+        # least capacity), and a type's want-count is how many nodes
+        # the assigned vectors PACK into — not one node per vector
+        # (ADVICE r5: 6 x {CPU:1} against a CPU:4 type needs 2 nodes,
+        # not 6).
         counts = self._nodes_by_type(nodes)
         total = len(nodes)
-        want: Dict[Optional[str], int] = {}
+        assigned: Dict[Optional[str], List[Dict[str, float]]] = {}
         unmatched = 0
+        default_res = getattr(
+            self.provider, "default_node_resources", None)
         for demand in demand_vectors:
             chosen = None
             if worker_types:
-                for name, spec in worker_types.items():
-                    if _fits(spec.get("resources") or {}, demand):
-                        chosen = name
-                        break
+                fitting = [
+                    name for name, spec in worker_types.items()
+                    if _fits(spec.get("resources") or {}, demand)]
+                if not fitting:
+                    unmatched += 1
+                    continue
+                chosen = min(fitting, key=lambda n: _fit_preference(
+                    worker_types[n].get("resources") or {}, demand))
             else:
-                default_res = getattr(
-                    self.provider, "default_node_resources", None)
                 if default_res is None or _fits(default_res, demand):
                     chosen = None  # default type serves it
                 else:
                     unmatched += 1
                     continue
-            if chosen is None and worker_types:
-                unmatched += 1
-                continue
-            want[chosen] = want.get(chosen, 0) + 1
+            assigned.setdefault(chosen, []).append(demand)
         if unmatched:
             logger.warning(
                 "autoscaler: %d pending demand vector(s) fit no "
                 "configured worker type (types: %s) — not launching "
                 "for them", unmatched,
                 sorted(worker_types) or "[default]")
+        want: Dict[Optional[str], int] = {}
+        for node_type, vectors in assigned.items():
+            if node_type is not None:
+                shape = worker_types[node_type].get("resources") or {}
+            elif default_res is not None:
+                shape = default_res
+            else:
+                # Unknown default-node shape: keep the legacy 1:1.
+                want[node_type] = len(vectors)
+                continue
+            want[node_type] = _nodes_needed(shape, vectors)
         # max_launch_batch is a PER-TICK budget across all types, and a
-        # type never gets more nodes than it has demand vectors.
+        # type never gets more nodes than its packed demand needs.
         budget = batch
         for node_type, n_want in sorted(
                 want.items(), key=lambda kv: -kv[1]):

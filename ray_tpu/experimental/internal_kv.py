@@ -25,10 +25,11 @@ def _internal_kv_initialized() -> bool:
         return False
 
 
-def _internal_kv_put(key: str, value, overwrite: bool = True) -> bool:
+def _internal_kv_put(key: str, value, overwrite: bool = False) -> bool:
     """Store key -> value; returns True iff the key already existed
-    (reference semantics). With overwrite=False an existing value is
-    left untouched."""
+    (reference semantics, `python/ray/experimental/internal_kv.py`:
+    the default is NO-CLOBBER — an existing value is left untouched
+    unless overwrite=True is passed explicitly)."""
     reply = _head().request(
         {"kind": "kv_put", "key": "ikv:" + key, "value": value,
          "overwrite": overwrite}, timeout=30)

@@ -118,11 +118,74 @@ def main(quick: bool = False):
     results["wait_per_s"] = timeit("wait (ready object)", wait_ready)
 
     ray_tpu.shutdown()
+    results.update(transfer_benchmarks(quick=quick))
+    return results
+
+
+def transfer_benchmarks(quick: bool = False):
+    """Cross-node data plane: a second node agent on this box owns the
+    objects; driver fetches over the striped wire (the path A/B'd in
+    PERF.md — RAY_TPU_TRANSFER_STREAMS / RAY_TPU_WIRE_COMPRESSION env
+    gate the striping and codec for same-box comparisons)."""
+    import ray_tpu
+    from ray_tpu.cluster_utils import Cluster
+
+    scale = 1 if quick else 4
+    results = {}
+    cluster = Cluster(head_resources={"CPU": 2})
+    cluster.add_node(resources={"CPU": 2, "XFER": 8})
+
+    @ray_tpu.remote(resources={"XFER": 1})
+    class Owner:
+        def __init__(self):
+            self._rng = np.random.default_rng(0)
+
+        def put_many(self, n, nbytes):
+            # Incompressible payloads: the codec probe must ship these
+            # raw, so striping (not compression) is what's measured.
+            return [ray_tpu.put(self._rng.integers(
+                0, 256, nbytes, dtype=np.uint8)) for _ in range(n)]
+
+    owner = Owner.remote()
+    two_mb = 2 << 20
+    rounds = 5  # timeit: 1 warmup + 3 timed (+1 margin)
+
+    def pooled_gets(n, batch):
+        """Refs are created OUTSIDE the timed window (each round pops
+        fresh ones, so every get is a real wire fetch, never a local
+        cache hit) — the timed path is the transfer, not the owner's
+        put."""
+        pool = iter(ray_tpu.get(
+            owner.put_many.remote(n * rounds, two_mb), timeout=120))
+
+        def fn():
+            refs = [next(pool) for _ in range(n)]
+            if batch:
+                vals = ray_tpu.get(refs, timeout=120)
+            else:
+                vals = [ray_tpu.get(r, timeout=60) for r in refs]
+            assert all(v.nbytes == two_mb for v in vals)
+            return n
+        return fn
+
+    results["xfer_2mb_per_s"] = timeit(
+        "get (2 MB, cross-node wire, sequential)",
+        pooled_gets(3 * scale, batch=False))
+    results["xfer_2mb_batch_per_s"] = timeit(
+        "get (2 MB, cross-node wire, parallel multi-ref)",
+        pooled_gets(6 * scale, batch=True))
+    cluster.shutdown()
     return results
 
 
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
+    parser.add_argument("--transfer-only", action="store_true",
+                        help="run only the cross-node data-plane "
+                             "benchmarks (A/B runs)")
     args = parser.parse_args()
-    main(quick=args.quick)
+    if args.transfer_only:
+        transfer_benchmarks(quick=args.quick)
+    else:
+        main(quick=args.quick)

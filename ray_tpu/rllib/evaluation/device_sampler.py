@@ -93,6 +93,16 @@ class DeviceSebulbaSampler:
         self.t_fetch = 0.0       # host blocked waiting for actions
         self.t_env = 0.0         # host inside env.vector_step
         self.steps_total = 0
+        # Wire-codec probe: every Nth upload, a sample of the staged
+        # obs buffer runs through the runtime's wire codec
+        # (_private/serialization.StreamEncoder) to measure what the
+        # striped data plane would put on a host-to-host wire for this
+        # stream. Sampled, because compressing every upload inline
+        # would gate the sampler; the ratio is what bench.py needs.
+        self.wire_probe_raw = 0
+        self.wire_probe_wire = 0
+        self._wire_probe_every = 64
+        self._wire_uploads = 0
 
         if self.frame_stack:
             space = self.env.observation_space
@@ -235,6 +245,7 @@ class DeviceSebulbaSampler:
             packed = self._pack_step(idx, val, done)
             packed_d = jax.device_put(packed, policy._bsharded)
             self.bytes_h2d += packed.nbytes
+            self._wire_probe(packed)
             with policy._update_lock:
                 self._pending = self._step_fn(
                     policy.params, self._stack, self._frames_d,
@@ -245,6 +256,7 @@ class DeviceSebulbaSampler:
             frame_d = jax.device_put(frame, policy._bsharded)
             done_d = jax.device_put(done, policy._bsharded)
             self.bytes_h2d += frame.nbytes + done.nbytes
+            self._wire_probe(frame)
             with policy._update_lock:
                 self._pending = self._step_fn(
                     policy.params, self._stack, frame_d, done_d,
@@ -342,6 +354,17 @@ class DeviceSebulbaSampler:
         self.metrics = []
         return out
 
+    def _wire_probe(self, arr) -> None:
+        self._wire_uploads += 1
+        if self._wire_uploads % self._wire_probe_every:
+            return
+        from ray_tpu._private import serialization as _ser
+        mv = memoryview(np.ascontiguousarray(arr)).cast("B")
+        sample = bytes(mv[:262144])
+        _, payload = _ser.StreamEncoder(mode="on").encode(sample)
+        self.wire_probe_raw += len(sample)
+        self.wire_probe_wire += len(payload)
+
     def transfer_stats(self) -> dict:
         return {
             "bytes_h2d": self.bytes_h2d,
@@ -349,4 +372,6 @@ class DeviceSebulbaSampler:
             "t_fetch_s": round(self.t_fetch, 3),
             "t_env_s": round(self.t_env, 3),
             "steps": self.steps_total,
+            "wire_probe_raw": self.wire_probe_raw,
+            "wire_probe_wire": self.wire_probe_wire,
         }

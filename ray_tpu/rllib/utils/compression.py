@@ -15,28 +15,24 @@ form both faster and better-compressing.
 from __future__ import annotations
 
 import pickle
-import zlib
 
 import numpy as np
 
-try:  # pragma: no cover - lz4 not in the base image
-    import lz4.frame as _lz4
+# One codec in the tree: the runtime's wire codec (_private/serialization)
+# owns the lz4-if-available / zlib(1)-fallback primitives; the column
+# compression here and the data plane's chunk compression share them.
+from ..._private.serialization import (WIRE_CODEC_ID, WIRE_CODEC_NAME,
+                                       _codec_compress, wire_decode)
 
-    def _compress(data: bytes) -> bytes:
-        return _lz4.compress(data)
+CODEC = WIRE_CODEC_NAME
 
-    def _decompress(data: bytes) -> bytes:
-        return _lz4.decompress(data)
 
-    CODEC = "lz4"
-except ImportError:
-    def _compress(data: bytes) -> bytes:
-        return zlib.compress(data, 1)
+def _compress(data: bytes) -> bytes:
+    return _codec_compress(data)
 
-    def _decompress(data: bytes) -> bytes:
-        return zlib.decompress(data)
 
-    CODEC = "zlib"
+def _decompress(data: bytes) -> bytes:
+    return wire_decode(WIRE_CODEC_ID, data)
 
 # Default columns worth compressing: the image-sized ones.
 DEFAULT_COLUMNS = ("obs", "new_obs", "bootstrap_obs")

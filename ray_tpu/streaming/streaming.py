@@ -27,7 +27,11 @@ them (the downstream reports its checkpoint-covered seq in every ack).
 When a drain observes the downstream died, the sender replays every
 retained item — retired-but-uncovered first, then the unacked window —
 in order, against the restarted actor. The receiver dedups by seq
-against its restored state. Net guarantee WITH a `checkpoint_dir`:
+against its restored state, and REFUSES items past a sequence hole
+(crash after ack, before checkpoint: the sender never observed the
+death, so its next ordinary push would otherwise silently skip the
+lost suffix) by acking `{"replay_from": <applied>}`; the sender then
+replays its retention from that point. Net guarantee WITH a `checkpoint_dir`:
 **effectively-once** per edge into operator state for deterministic
 operators (replays reconstruct exactly the uncheckpointed suffix; no
 loss, no double-apply). Without a checkpoint_dir, state restarts EMPTY
@@ -109,6 +113,18 @@ class EdgeSender:
             ref, item, key, seq = self.inflight[0]
             try:
                 ack = ray_tpu.get(ref)
+                if isinstance(ack, dict) and "replay_from" in ack:
+                    # The receiver refused this item: it restarted with
+                    # a hole between its restored state and our stream
+                    # (crash after ack, before checkpoint). Replay the
+                    # retention — retired-but-uncovered first, then the
+                    # unacked window (this item included) — and keep
+                    # draining the re-pushed stream.
+                    self.covered = max(self.covered,
+                                       int(ack["replay_from"]))
+                    self._trim_retired()
+                    self._replay()
+                    continue
                 self.inflight.popleft()
                 self.retired.append((item, key, seq))
                 if isinstance(ack, int):
@@ -129,16 +145,32 @@ class EdgeSender:
     def _replay(self) -> None:
         """Re-push everything the downstream's checkpoint does not
         cover, in seq order (the receiver dedups anything it has
-        already applied post-restore)."""
+        already applied post-restore). When retention cannot reach back
+        to `covered + 1` (checkpointing off: nothing is retained past
+        the ack), the first replayed item carries `resync=True` so the
+        receiver accepts the unfillable hole instead of refusing the
+        stream forever."""
         items = [(item, key, seq) for item, key, seq in self.retired
                  if seq > self.covered]
         items += [(item, key, seq) for _, item, key, seq
                   in self.inflight]
         self.retired = deque(
             (i, k, s) for i, k, s in self.retired if s <= self.covered)
+        resync_first = bool(items) and items[0][2] > self.covered + 1
+
+        def push(i, item, key, seq):
+            if resync_first and i == 0:
+                return self.handle.process.remote(item, key, seq,
+                                                  self.edge_id, True)
+            # 4-arg form keeps duck-typed receivers without a resync
+            # parameter working (only _OperatorActor-style int acks
+            # can ever produce a resync-worthy hole).
+            return self.handle.process.remote(item, key, seq,
+                                              self.edge_id)
+
         self.inflight = deque(
-            (self.handle.process.remote(item, key, seq, self.edge_id),
-             item, key, seq) for item, key, seq in items)
+            (push(i, item, key, seq), item, key, seq)
+            for i, (item, key, seq) in enumerate(items))
 
     def drain_all(self) -> None:
         while self.inflight:
@@ -187,13 +219,33 @@ class _OperatorActor:
         self._since_ckpt = 0
 
     # -- data plane ------------------------------------------------------
-    def process(self, item, key=None, seq=None, edge=None):
+    def process(self, item, key=None, seq=None, edge=None,
+                resync=False):
         """Apply one item; returns this edge's checkpoint-covered seq
         (the sender's retention watermark). Duplicate seqs (replays of
-        already-applied items) are skipped but still acked."""
+        already-applied items) are skipped but still acked.
+
+        GAP HANDLING (effectively-once fix): a seq beyond
+        `last_applied + 1` means items were lost in a hole — the
+        classic sequence is this operator crashing after acking items
+        it had applied but not yet checkpointed, restarting from the
+        checkpoint, then receiving the sender's NEXT item. Applying
+        past the hole would silently drop the uncheckpointed suffix,
+        so the item is REFUSED and `{"replay_from": <applied>}` is
+        returned; the sender replays its retention from there (see
+        `EdgeSender.drain_oldest`). `resync=True` marks the first item
+        of a replay whose sender retains nothing older (checkpointing
+        off — at-least-once of the retained window is the documented
+        contract): the receiver accepts the hole knowingly and
+        fast-forwards its applied seq."""
         if edge is not None and seq is not None:
-            if seq <= self._edge_seq.get(edge, 0):
+            applied = self._edge_seq.get(edge, 0)
+            if seq <= applied:
                 return self._ack(edge)
+            if seq > applied + 1:
+                if not resync:
+                    return {"replay_from": applied}
+                self._edge_seq[edge] = seq - 1  # accept the hole
             self._edge_seq[edge] = seq
         if self.kind == "map":
             self._emit(self.fn(item), key)

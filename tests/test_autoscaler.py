@@ -209,6 +209,41 @@ class TestDemandShape:
         finally:
             ray_tpu.shutdown()
 
+    def test_packed_want_count_not_one_node_per_vector(self):
+        """ADVICE r5 over-provisioning fix: 6 x {CPU:1} against a CPU:4
+        type needs ceil(6/4)=2 nodes, not 6."""
+        p, lm, a = self._make()
+        lm.pending_demand = [{"CPU": 1.0}] * 6
+        lm.queued_demand = 6
+        a.update()
+        assert len(p.nodes) == 2, p.nodes
+        assert all(p.node_type(n) == "cpu" for n in p.nodes)
+
+    def test_smallest_fitting_type_preferred(self):
+        p, lm = FakeProvider(), LoadMetrics()
+        a = StandardAutoscaler(p, lm, {
+            "min_workers": 0, "max_workers": 8, "max_launch_batch": 4,
+            "worker_types": {
+                "big": {"resources": {"CPU": 16.0}},
+                "small": {"resources": {"CPU": 2.0}},
+            }})
+        lm.pending_demand = [{"CPU": 1.0}, {"CPU": 1.0}]
+        lm.queued_demand = 2
+        a.update()
+        # Both vectors pack into ONE node of the smallest fitting type.
+        assert [p.node_type(n) for n in p.nodes] == ["small"]
+
+    def test_heterogeneous_vectors_pack_by_first_fit(self):
+        p, lm, a = self._make()  # cpu type has CPU:4
+        lm.pending_demand = [{"CPU": 3.0}, {"CPU": 2.0}, {"CPU": 1.0},
+                             {"CPU": 2.0}]
+        lm.queued_demand = 4
+        a.update()
+        # FFD packing: [3,1] + [2,2] -> 2 nodes.
+        cpu = [n for n in p.nodes if p.node_type(n) == "cpu"]
+        assert len(cpu) == 2, p.nodes
+
+
 
 class TestConfigValidation:
     def test_unknown_key_rejected_listing_valid(self):

@@ -136,9 +136,14 @@ class TestInternalKV:
         from ray_tpu.experimental import internal_kv as kv
         assert kv._internal_kv_initialized()
         assert kv._internal_kv_put("a/1", b"v1") is False  # fresh key
+        # Reference semantics: the DEFAULT is no-clobber — a second put
+        # reports the key existed and leaves the stored value alone.
         assert kv._internal_kv_put("a/1", b"v2") is True   # existed
+        assert kv._internal_kv_get("a/1") == b"v1"
+        # Explicit overwrite=True replaces.
+        assert kv._internal_kv_put("a/1", b"v2", overwrite=True) is True
         assert kv._internal_kv_get("a/1") == b"v2"
-        # overwrite=False preserves the old value.
+        # overwrite=False (explicit) also preserves the old value.
         kv._internal_kv_put("a/1", b"v3", overwrite=False)
         assert kv._internal_kv_get("a/1") == b"v2"
         kv._internal_kv_put("a/2", {"obj": 1})
@@ -157,7 +162,8 @@ class TestInternalKV:
         def bump():
             from ray_tpu.experimental import internal_kv as kv2
             v = kv2._internal_kv_get("shared") + 1
-            kv2._internal_kv_put("shared", v)
+            # Updates need overwrite=True (reference no-clobber default).
+            kv2._internal_kv_put("shared", v, overwrite=True)
             return v
 
         assert ray_tpu.get(bump.remote()) == 42

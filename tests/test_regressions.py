@@ -197,3 +197,25 @@ class TestAdvisoryFixes:
         finally:
             ray_tpu.shutdown()
             del os.environ["RAY_TPU_OBJECT_STORE_CAPACITY"]
+
+
+class TestBenchMedianWindows:
+    def test_even_window_count_uses_median_low(self):
+        """ADVICE r5: statistics.median of an even count averages the
+        middle two — a rate belonging to NO window, so the extra lookup
+        crashed. median_low always names a real window."""
+        import bench
+        calls = iter([(10.0, "w0"), (30.0, "w1"), (20.0, "w2"),
+                      (40.0, "w3")])
+        med, stddev_pct, extra, rates = bench.median_windows(
+            lambda: next(calls), n=4)
+        assert med == 20.0          # lower of the middle pair {20, 30}
+        assert extra == "w2"        # the extra of THAT window
+        assert rates == [10.0, 30.0, 20.0, 40.0]
+
+    def test_odd_window_count_unchanged(self):
+        import bench
+        calls = iter([(10.0, "a"), (30.0, "b"), (20.0, "c")])
+        med, _, extra, _ = bench.median_windows(lambda: next(calls),
+                                                n=3)
+        assert med == 20.0 and extra == "c"
