@@ -111,6 +111,13 @@ _def("RAY_TPU_TASK_LOG_MAX", int, 4096,
 _def("RAY_TPU_NUM_ACTOR_CHECKPOINTS_TO_KEEP", int, 20,
      "Checkpoint ids retained per Checkpointable actor")
 
+# --- correctness tooling (graftcheck) ---------------------------------
+_def("RAY_TPU_LOCKCHECK", bool, False,
+     "Wrap runtime locks in order-tracing shims (graftcheck runtime "
+     "mode): real acquisition orders are recorded per thread and "
+     "inversions surface via graftcheck.runtime_trace.get_violations()."
+     " Test-time knob; off = plain threading locks, zero overhead")
+
 # --- native components ------------------------------------------------
 _def("RAY_TPU_NATIVE", bool, True,
      "Use compiled C++ components (0 forces pure-Python fallbacks)")

@@ -20,12 +20,59 @@ memory — is visibility into every thread's stack:
 from __future__ import annotations
 
 import faulthandler
+import logging
 import signal
 import sys
 import threading
 import time
 
+logger = logging.getLogger("ray_tpu")
+
 _installed = False
+_excepthook_installed = False
+
+
+def install_thread_excepthook() -> None:
+    """Surface uncaught exceptions in service threads (idempotent).
+
+    A daemon thread dying silently is the worst failure mode this
+    runtime has: the loop it ran (heartbeats, result pushes, borrow
+    notifications) just stops. The hook logs the crash with its
+    traceback, bumps the `thread_crash_total` counter in the metrics
+    plane (visible in `ray_tpu stat --metrics` / Prometheus), and
+    best-effort reports it to the head's error stream so the driver
+    console shows it.
+    """
+    global _excepthook_installed
+    if _excepthook_installed:
+        return
+    _excepthook_installed = True
+
+    def hook(args, /):
+        if args.exc_type is SystemExit:
+            return  # normal thread exit path
+        name = args.thread.name if args.thread is not None else "?"
+        logger.error("uncaught exception in thread %r", name,
+                     exc_info=(args.exc_type, args.exc_value,
+                               args.exc_traceback))
+        try:
+            from . import metrics
+            metrics.inc("thread_crash_total")
+        except Exception:
+            pass
+        try:
+            from . import worker_state
+            rt = worker_state.get_runtime_or_none()
+            head = getattr(rt, "head", None)
+            if head is not None:
+                head.send({
+                    "kind": "report_error",
+                    "data": (f"thread {name!r} crashed: "
+                             f"{args.exc_value!r}")[:300]})
+        except Exception:
+            pass  # reporting must never re-crash the dying thread
+
+    threading.excepthook = hook
 
 
 def install_signal_dump() -> None:

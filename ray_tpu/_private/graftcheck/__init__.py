@@ -1,0 +1,55 @@
+"""graftcheck: framework-aware static analysis for the ray_tpu tree.
+
+Two halves (see README "Correctness tooling"):
+
+- an AST lint pass with rules for distributed anti-patterns (blocking
+  ``ray_tpu.get`` inside remote code, large literals captured in remote
+  closures, forgotten ``.remote()``, mutable defaults on remote
+  signatures, swallowed exceptions in service loops, daemon service
+  threads without a join path) — ``lint_rules.py``;
+- a concurrency checker: a statically-built lock-acquisition graph
+  over the runtime modules with cycle detection (``lockgraph.py``),
+  plus an env-gated runtime tracer (``RAY_TPU_LOCKCHECK=1``,
+  ``runtime_trace.py``) that records real acquisition orders and flags
+  inversions while tests run.
+
+Findings are structured (rule id, path:line, severity), support a
+checked-in suppression baseline, and the CLI
+(``python -m ray_tpu.scripts check``) exits non-zero on new findings.
+The shipped tree passes clean; the tier-1 gate in
+``tests/test_graftcheck.py`` keeps it that way.
+"""
+
+from __future__ import annotations
+
+from .findings import Baseline, Finding, load_inline_suppressions
+from .rules import ModuleContext, RULE_REGISTRY, iter_py_files, run_lint
+from .lockgraph import LockGraph, analyze_lock_order
+from . import runtime_trace
+
+__all__ = [
+    "Baseline", "Finding", "LockGraph", "ModuleContext", "RULE_REGISTRY",
+    "analyze_lock_order", "iter_py_files", "load_inline_suppressions",
+    "run_check", "run_lint", "runtime_trace",
+]
+
+
+def run_check(paths, baseline: "Baseline | None" = None,
+              lockgraph: bool = True):
+    """Full analysis over `paths` (files or directories): lint rules +
+    static lock-order cycles, minus baseline/inline suppressions.
+    Returns (new_findings, suppressed_findings)."""
+    files = iter_py_files(paths)
+    findings = list(run_lint(files))
+    if lockgraph:
+        findings.extend(analyze_lock_order(files).findings)
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    if baseline is None:
+        baseline = Baseline.empty()
+    new, suppressed = [], []
+    for f in findings:
+        if baseline.matches(f) or f.inline_suppressed:
+            suppressed.append(f)
+        else:
+            new.append(f)
+    return new, suppressed

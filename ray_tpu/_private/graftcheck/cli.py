@@ -1,0 +1,80 @@
+"""The `ray_tpu check` entry point (wired in scripts/scripts.py).
+
+    python -m ray_tpu.scripts check [paths...]
+        [--baseline FILE] [--write-baseline] [--json] [--no-lockgraph]
+
+Exit status: 0 when no unsuppressed findings, 1 otherwise. The
+shipped tree passes clean; `tests/test_graftcheck.py::test_self_clean`
+holds that line in tier-1.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import run_check
+from .findings import Baseline
+from .reporter import print_json, print_text
+
+
+def run(paths: List[str], baseline_path: Optional[str] = None,
+        write_baseline: bool = False, as_json: bool = False,
+        lockgraph: bool = True, stream=None) -> int:
+    paths = paths or ["ray_tpu"]
+    missing = [p for p in paths if not os.path.exists(p)]
+    if missing:
+        print(f"graftcheck: no such path(s): {', '.join(missing)}",
+              file=stream or sys.stderr)
+        return 2
+    if baseline_path is not None:
+        baseline = Baseline.load(baseline_path)
+    else:
+        baseline = Baseline.find_default(paths)
+    new, suppressed = run_check(paths, baseline=baseline,
+                                lockgraph=lockgraph)
+    if write_baseline:
+        out = baseline_path or baseline.path \
+            or os.path.join(os.getcwd(), ".graftcheck-baseline.json")
+        Baseline.write(out, new + [f for f in suppressed
+                                   if not f.inline_suppressed])
+        print(f"graftcheck: wrote baseline with "
+              f"{len(new) + len(suppressed)} entr(ies) to {out}",
+              file=stream or sys.stdout)
+        return 0
+    if as_json:
+        print_json(new, suppressed, stream=stream)
+    else:
+        print_text(new, suppressed, stream=stream)
+    return 1 if new else 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="ray_tpu.scripts check",
+        description="framework-aware static analysis + lock-order "
+                    "race detection")
+    parser.add_argument("paths", nargs="*", default=["ray_tpu"],
+                        help="files or directories to analyze "
+                             "(default: ray_tpu)")
+    parser.add_argument("--baseline", default=None,
+                        help="suppression baseline JSON (default: "
+                             ".graftcheck-baseline.json found near cwd "
+                             "or the scanned path)")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write the current findings as the new "
+                             "baseline instead of failing")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable output")
+    parser.add_argument("--no-lockgraph", action="store_true",
+                        help="skip the static lock-order pass")
+    args = parser.parse_args(argv)
+    return run(args.paths, baseline_path=args.baseline,
+               write_baseline=args.write_baseline, as_json=args.json,
+               lockgraph=not args.no_lockgraph)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,147 @@
+"""Structured findings + the suppression machinery.
+
+A finding is identified for baseline purposes by (rule, path,
+context) where `context` is the enclosing function/class qualname —
+stable across unrelated line churn, unlike raw line numbers. Two
+suppression channels:
+
+- the checked-in baseline file (JSON; default
+  ``.graftcheck-baseline.json`` at the repo root): grandfathers known
+  findings so the CLI only fails on NEW ones;
+- inline ``# graftcheck: disable=GC105`` comments on the flagged line
+  (or ``disable-file=`` anywhere in the file) for point suppressions
+  that belong next to the code they excuse.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import re
+from typing import Dict, List, Optional, Set
+
+SEVERITY_ERROR = "error"
+SEVERITY_WARNING = "warning"
+
+_INLINE_RE = re.compile(
+    r"#\s*graftcheck:\s*disable(?P<scope>-file)?\s*=\s*"
+    r"(?P<rules>[A-Z]+[0-9]+(?:\s*,\s*[A-Z]+[0-9]+)*)")
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str          # repo-relative (posix) where possible
+    line: int
+    severity: str
+    message: str
+    context: str = ""  # enclosing qualname, e.g. "Runtime._make_room"
+    inline_suppressed: bool = False
+
+    def key(self) -> tuple:
+        return (self.rule, _norm(self.path), self.context)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "severity": self.severity, "message": self.message,
+                "context": self.context}
+
+    def render(self) -> str:
+        ctx = f" [{self.context}]" if self.context else ""
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"{self.severity}: {self.message}{ctx}")
+
+
+def _norm(path: str) -> str:
+    return path.replace(os.sep, "/")
+
+
+def relpath(path: str) -> str:
+    """Path as stored on findings: relative to cwd when under it."""
+    ap = os.path.abspath(path)
+    cwd = os.getcwd()
+    if ap.startswith(cwd + os.sep):
+        return _norm(os.path.relpath(ap, cwd))
+    return _norm(ap)
+
+
+def load_inline_suppressions(source: str) -> tuple:
+    """Scan source text for inline markers. Returns
+    (file_level_rules, {line_no: rules})."""
+    file_rules: Set[str] = set()
+    line_rules: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _INLINE_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group("rules").split(",")}
+        if m.group("scope"):
+            file_rules |= rules
+        else:
+            line_rules.setdefault(i, set()).update(rules)
+    return file_rules, line_rules
+
+
+class Baseline:
+    """Checked-in grandfather list. Entries match findings on
+    (rule, path-suffix, context) so absolute-vs-relative invocation
+    paths and unrelated line churn don't break suppression."""
+
+    def __init__(self, entries: List[dict], path: Optional[str] = None):
+        self.entries = entries
+        self.path = path
+
+    @classmethod
+    def empty(cls) -> "Baseline":
+        return cls([])
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path) as f:
+            data = json.load(f)
+        if not isinstance(data, dict) or "suppressions" not in data:
+            raise ValueError(
+                f"{path}: expected {{'version': 1, 'suppressions': [...]}}")
+        return cls(list(data["suppressions"]), path=path)
+
+    @classmethod
+    def find_default(cls, start_paths) -> "Baseline":
+        """Look for .graftcheck-baseline.json in cwd, then next to the
+        first scanned path; absent file means an empty baseline."""
+        candidates = [os.path.join(os.getcwd(),
+                                   ".graftcheck-baseline.json")]
+        for p in start_paths:
+            base = p if os.path.isdir(p) else os.path.dirname(p)
+            candidates.append(os.path.join(
+                os.path.dirname(os.path.abspath(base)) or ".",
+                ".graftcheck-baseline.json"))
+        for c in candidates:
+            if os.path.exists(c):
+                return cls.load(c)
+        return cls.empty()
+
+    def matches(self, f: Finding) -> bool:
+        fp = _norm(f.path)
+        for e in self.entries:
+            if e.get("rule") != f.rule:
+                continue
+            ep = _norm(e.get("path", ""))
+            if not (fp == ep or fp.endswith("/" + ep)
+                    or ep.endswith("/" + fp)):
+                continue
+            ectx = e.get("context")
+            if ectx is None or ectx == f.context:
+                return True
+        return False
+
+    @staticmethod
+    def write(path: str, findings: List[Finding]) -> None:
+        entries = sorted(
+            {(f.rule, _norm(f.path), f.context) for f in findings})
+        data = {"version": 1, "suppressions": [
+            {"rule": r, "path": p, "context": c}
+            for r, p, c in entries]}
+        with open(path, "w") as f:
+            json.dump(data, f, indent=2, sort_keys=True)
+            f.write("\n")

@@ -1,0 +1,316 @@
+"""Framework-aware lint rules for distributed anti-patterns.
+
+Rule catalog (ids are stable; see README "Correctness tooling"):
+
+- GC101 blocking-get-in-remote: ``ray_tpu.get()``/``wait()`` inside a
+  ``@remote`` task or actor method blocks a worker slot on another
+  task's completion — a classic distributed deadlock shape under load.
+- GC102 large-capture-in-remote: a large literal shipped inside a
+  remote call (or embedded in a remote function body) is re-pickled on
+  every submission; ``ray_tpu.put()`` once and pass the ref.
+- GC103 missing-dot-remote: calling a remote function directly raises
+  at runtime; the lint catches it before any worker does.
+- GC104 mutable-default-on-remote: mutable default args on remote/
+  actor signatures are shared across calls that may run in different
+  processes — state silently diverges from local-execution intuition.
+- GC105 swallowed-exception-in-loop: a service loop whose iteration
+  body swallows all exceptions (`except: pass`) turns crashes into
+  silent wedges. Bare ``except:`` is flagged anywhere.
+- GC106 unjoined-service-thread: a daemon thread running a ``*_loop``
+  service target must be stored and joined on some shutdown path, or
+  repeated init/shutdown leaks threads between tests.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Set
+
+from .findings import Finding, SEVERITY_ERROR, SEVERITY_WARNING
+from .rules import ModuleContext, Rule, const_size, register
+
+# Literal "size" (elements + string/bytes chars) above which a capture
+# should be a put() — matches the order of magnitude where per-call
+# pickling starts to show up in submit latency.
+LARGE_LITERAL_SIZE = 4096
+
+# Attribute/function names whose best-effort cleanup in a loop body is
+# legitimately fire-and-forget (closing a dying connection must not
+# itself crash the loop).
+_CLEANUP_CALL_NAMES = frozenset(
+    {"close", "kill", "terminate", "unlink", "cancel", "stop",
+     "shutdown", "release"})
+
+_BROAD_EXC_NAMES = frozenset({"Exception", "BaseException"})
+
+
+@register
+class BlockingGetInRemote(Rule):
+    id = "GC101"
+    severity = SEVERITY_WARNING
+    doc = ("ray_tpu.get()/wait() inside a @remote task or actor "
+           "method body blocks a worker slot")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for fn, owner in ctx.iter_remote_callables():
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and f.attr in ("get", "wait") \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id in ctx.ray_aliases:
+                    kind = "actor method" if owner is not None else "task"
+                    yield ctx.finding(
+                        self, node,
+                        f"blocking {f.value.id}.{f.attr}() inside remote "
+                        f"{kind} '{fn.name}' ties up a worker slot; "
+                        f"return the ref and get() at the caller",
+                        context_node=fn)
+
+
+@register
+class LargeCaptureInRemote(Rule):
+    id = "GC102"
+    severity = SEVERITY_WARNING
+    doc = ("large literal shipped through a remote call instead of "
+           "ray_tpu.put()")
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        # Large literal arguments at .remote() call sites.
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "remote":
+                args = list(node.args) + [kw.value for kw in node.keywords]
+                for a in args:
+                    size = const_size(a)
+                    if size >= LARGE_LITERAL_SIZE:
+                        yield ctx.finding(
+                            self, a,
+                            f"literal of ~{size} elements/chars passed to "
+                            f".remote(); put() it once and pass the "
+                            f"ObjectRef")
+        # Large literals embedded in remote function/method bodies
+        # (captured by the pickled closure on every export).
+        for fn, _owner in ctx.iter_remote_callables():
+            for node in ast.walk(fn):
+                if isinstance(node, (ast.List, ast.Tuple, ast.Set,
+                                     ast.Dict, ast.Constant, ast.BinOp)):
+                    parent = ctx.parents.get(node)
+                    if isinstance(parent, (ast.List, ast.Tuple, ast.Set,
+                                           ast.Dict, ast.BinOp)):
+                        continue  # counted by the enclosing literal
+                    if isinstance(node, ast.Constant) \
+                            and isinstance(parent, ast.Expr):
+                        continue  # docstring
+                    size = const_size(node)
+                    if size >= LARGE_LITERAL_SIZE:
+                        yield ctx.finding(
+                            self, node,
+                            f"literal of ~{size} elements/chars embedded "
+                            f"in remote '{fn.name}' ships with every "
+                            f"function export; load it inside the task "
+                            f"or pass a put() ref",
+                            context_node=fn)
+
+
+@register
+class MissingDotRemote(Rule):
+    id = "GC103"
+    severity = SEVERITY_ERROR
+    doc = "remote function called directly instead of via .remote()"
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        remote_names: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) and ctx.is_remote_def(node):
+                remote_names.add(node.name)
+        if not remote_names:
+            return
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Name) \
+                    and node.func.id in remote_names:
+                yield ctx.finding(
+                    self, node,
+                    f"'{node.func.id}' is a remote function/actor class; "
+                    f"call '{node.func.id}.remote(...)' "
+                    f"(a direct call raises TypeError at runtime)")
+
+
+@register
+class MutableDefaultOnRemote(Rule):
+    id = "GC104"
+    severity = SEVERITY_ERROR
+    doc = "mutable default argument on a remote/actor signature"
+
+    _MUTABLE_CTORS = frozenset({"list", "dict", "set", "bytearray",
+                                "deque", "defaultdict", "Counter",
+                                "OrderedDict"})
+
+    def _is_mutable_default(self, node: ast.expr) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set,
+                             ast.ListComp, ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            name = f.id if isinstance(f, ast.Name) else \
+                f.attr if isinstance(f, ast.Attribute) else ""
+            return name in self._MUTABLE_CTORS
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for fn, owner in ctx.iter_remote_callables():
+            defaults = list(fn.args.defaults) \
+                + [d for d in fn.args.kw_defaults if d is not None]
+            for d in defaults:
+                if self._is_mutable_default(d):
+                    where = f"method '{owner.name}.{fn.name}'" \
+                        if owner is not None else f"function '{fn.name}'"
+                    yield ctx.finding(
+                        self, d,
+                        f"mutable default on remote {where}: defaults "
+                        f"are evaluated once per worker process and "
+                        f"shared across calls; use None and construct "
+                        f"inside the body",
+                        context_node=fn)
+
+
+@register
+class SwallowedExceptionInLoop(Rule):
+    id = "GC105"
+    severity = SEVERITY_ERROR
+    doc = ("service-loop iteration swallows all exceptions "
+           "(or bare except anywhere)")
+
+    def _is_broad(self, handler: ast.ExceptHandler) -> bool:
+        t = handler.type
+        if t is None:
+            return True
+        if isinstance(t, ast.Name):
+            return t.id in _BROAD_EXC_NAMES
+        if isinstance(t, ast.Attribute):
+            return t.attr in _BROAD_EXC_NAMES
+        if isinstance(t, ast.Tuple):
+            return any(self._is_broad(ast.ExceptHandler(type=e))
+                       for e in t.elts)
+        return False
+
+    def _is_cleanup_try(self, try_node: ast.Try) -> bool:
+        """Best-effort cleanup: the try body is a single call to a
+        close/kill/... method — swallowing there is legitimate."""
+        if len(try_node.body) != 1:
+            return False
+        stmt = try_node.body[0]
+        if not (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Call)):
+            return False
+        f = stmt.value.func
+        name = f.attr if isinstance(f, ast.Attribute) else \
+            f.id if isinstance(f, ast.Name) else ""
+        return name in _CLEANUP_CALL_NAMES
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Try):
+                continue
+            for handler in node.handlers:
+                if handler.type is None:
+                    yield ctx.finding(
+                        self, handler,
+                        "bare 'except:' also catches SystemExit/"
+                        "KeyboardInterrupt; catch Exception (and "
+                        "handle or log it)")
+                    continue
+                if not self._is_broad(handler):
+                    continue
+                body_is_pass = all(isinstance(s, ast.Pass)
+                                   for s in handler.body)
+                if not body_is_pass:
+                    continue
+                parent = ctx.parents.get(node)
+                in_loop_body = isinstance(parent, (ast.While, ast.For)) \
+                    and node in parent.body
+                if in_loop_body and not self._is_cleanup_try(node):
+                    yield ctx.finding(
+                        self, handler,
+                        "service-loop iteration swallows every "
+                        "exception ('except Exception: pass'): "
+                        "failures become silent wedges; log the "
+                        "error or narrow the except")
+
+
+@register
+class UnjoinedServiceThread(Rule):
+    id = "GC106"
+    severity = SEVERITY_ERROR
+    doc = ("daemon service thread ('*_loop' target) without a "
+           "registered join/shutdown path")
+
+    def _thread_ctor(self, node: ast.Call) -> bool:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "Thread" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id == "threading":
+            return True
+        return isinstance(f, ast.Name) and f.id == "Thread"
+
+    def _service_target_name(self, node: ast.Call) -> str:
+        daemon = False
+        target = ""
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+            elif kw.arg == "target":
+                v = kw.value
+                target = v.attr if isinstance(v, ast.Attribute) else \
+                    v.id if isinstance(v, ast.Name) else ""
+        if daemon and target.endswith("_loop"):
+            return target
+        return ""
+
+    def _joined_names(self, ctx: ModuleContext) -> Set[str]:
+        """Every name X for which `<expr>.X.join(...)` or `X.join(...)`
+        appears somewhere in the module."""
+        joined: Set[str] = set()
+        for node in ast.walk(ctx.tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "join":
+                obj = node.func.value
+                if isinstance(obj, ast.Attribute):
+                    joined.add(obj.attr)
+                elif isinstance(obj, ast.Name):
+                    joined.add(obj.id)
+        return joined
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        joined = self._joined_names(ctx)
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.Call)
+                    and self._thread_ctor(node)):
+                continue
+            target = self._service_target_name(node)
+            if not target:
+                continue
+            parent = ctx.parents.get(node)
+            bound = ""
+            if isinstance(parent, ast.Assign) and parent.targets:
+                t = parent.targets[0]
+                bound = t.attr if isinstance(t, ast.Attribute) else \
+                    t.id if isinstance(t, ast.Name) else ""
+            if not bound:
+                yield ctx.finding(
+                    self, node,
+                    f"daemon service thread for '{target}' is started "
+                    f"fire-and-forget; assign it and join it (with a "
+                    f"timeout) on the shutdown path")
+            elif bound not in joined:
+                yield ctx.finding(
+                    self, node,
+                    f"daemon service thread '{bound}' (target "
+                    f"'{target}') is never joined in this module; "
+                    f"repeated init/shutdown leaks the thread")

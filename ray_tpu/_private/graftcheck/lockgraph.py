@@ -1,0 +1,491 @@
+"""Static lock-acquisition graph over the runtime modules.
+
+Builds "lock A is held while lock B is acquired" edges from the AST —
+both direct ``with self._a: with self._b:`` nesting and indirect
+acquisition through helper calls (``with self._a: self._helper()``
+where the helper takes ``self._b``) — then flags cycles: a cycle means
+two code paths can take the same pair of locks in opposite orders,
+i.e. a latent deadlock.
+
+Resolution model (deliberately conservative — missed edges over false
+cycles):
+
+- A lock is identified per SITE, ``(OwnerClass, attr)`` for
+  ``self._x = threading.Lock()`` attributes and ``(module, name)`` for
+  module-level locks. ``threading.Condition(self._x)`` aliases to the
+  wrapped lock; a bare ``Condition()`` is its own (reentrant) lock.
+- ``with`` items count as acquisitions only when they resolve to a
+  KNOWN lock attribute (collected from assignments), so context
+  managers like ``with self._exec_span(..)`` never enter the graph.
+- Calls resolve to: same-class methods (``self.m()``), methods of
+  attributes with a known constructed or annotated type
+  (``self.shm = SharedObjectStore(...)``, ``runtime: "Runtime"``
+  parameters), same-module functions, and imported-module functions
+  (``from . import metrics as m; m.inc()``). Anything else —
+  notably dynamic callbacks and hooks — contributes no edge; the
+  runtime tracer (``runtime_trace.py``) covers those orders.
+- Reentrant locks (RLock/Condition) permit self-edges; a self-edge on
+  a plain Lock is reported as a guaranteed deadlock.
+
+The transitive "locks acquired by calling f" set is computed to a
+fixpoint over the (static) call graph, then every held-site x callee
+pair contributes edges.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .findings import Finding, SEVERITY_ERROR, relpath
+
+LockId = Tuple[str, str]     # (owner scope, attr/name)
+FuncId = Tuple[str, str]     # (module or class scope, function name)
+
+_LOCK_CTORS = {"Lock": "lock", "RLock": "rlock", "Condition": "condition",
+               "Semaphore": None, "BoundedSemaphore": None}
+
+
+class _FuncInfo:
+    __slots__ = ("fid", "module", "cls", "node", "direct_acquires",
+                 "calls", "held_calls", "nest_edges", "acq_lines")
+
+    def __init__(self, fid: FuncId, module: "_ModuleInfo",
+                 cls: Optional[str], node):
+        self.fid = fid
+        self.module = module
+        self.cls = cls
+        self.node = node
+        # Locks this function takes anywhere in its body.
+        self.direct_acquires: Set[LockId] = set()
+        # Every resolved callee (for the transitive-acquire fixpoint).
+        self.calls: Set[FuncId] = set()
+        # (held lock, callee, lineno) — edges via helper calls.
+        self.held_calls: List[Tuple[LockId, FuncId, int]] = []
+        # (outer, inner, lineno) — edges via lexical with-nesting.
+        self.nest_edges: List[Tuple[LockId, LockId, int]] = []
+        self.acq_lines: Dict[LockId, int] = {}
+
+
+class _ModuleInfo:
+    def __init__(self, path: str, name: str):
+        self.path = path
+        self.name = name
+        # class -> attr -> lock kind ('lock'|'rlock'|alias LockId)
+        self.lock_attrs: Dict[str, Dict[str, object]] = {}
+        # class -> attr -> type name (from ctor calls / annotations)
+        self.attr_types: Dict[str, Dict[str, str]] = {}
+        # import alias -> module basename
+        self.imports: Dict[str, str] = {}
+        self.classes: Set[str] = set()
+        self.module_locks: Dict[str, str] = {}  # name -> kind
+
+
+class LockGraph:
+    """The analysis result: edges, lock kinds, and cycle findings."""
+
+    def __init__(self):
+        self.edges: Dict[Tuple[LockId, LockId], Tuple[str, int]] = {}
+        self.lock_kinds: Dict[LockId, str] = {}
+        self.findings: List[Finding] = []
+
+    def add_edge(self, a: LockId, b: LockId, path: str, line: int):
+        if a == b:
+            return  # handled separately (reentrancy check)
+        self.edges.setdefault((a, b), (path, line))
+
+    def cycles(self) -> List[List[LockId]]:
+        """Elementary cycles via DFS over the edge set (the graph is
+        tiny — tens of nodes)."""
+        adj: Dict[LockId, Set[LockId]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, set()).add(b)
+        seen_cycles = set()
+        out: List[List[LockId]] = []
+
+        def dfs(start: LockId, node: LockId, path: List[LockId],
+                visited: Set[LockId]):
+            for nxt in sorted(adj.get(node, ())):
+                if nxt == start:
+                    cyc = path[:]
+                    key = frozenset(cyc)
+                    if key not in seen_cycles:
+                        seen_cycles.add(key)
+                        out.append(cyc)
+                elif nxt not in visited and nxt > start:
+                    # Only expand ids > start: each cycle found once,
+                    # from its smallest node.
+                    visited.add(nxt)
+                    dfs(start, nxt, path + [nxt], visited)
+                    visited.discard(nxt)
+
+        for node in sorted(adj):
+            dfs(node, node, [node], {node})
+        return out
+
+
+def _lock_ctor_kind(value: ast.expr) -> Optional[str]:
+    """'lock'/'rlock'/'condition' when `value` constructs one, via
+    `threading.X()` or a runtime_trace factory (`make_lock(...)`)."""
+    if not isinstance(value, ast.Call):
+        return None
+    f = value.func
+    name = f.attr if isinstance(f, ast.Attribute) else \
+        f.id if isinstance(f, ast.Name) else ""
+    if name in _LOCK_CTORS:
+        return _LOCK_CTORS[name]
+    if name in ("make_lock", "make_rlock", "make_condition"):
+        return {"make_lock": "lock", "make_rlock": "rlock",
+                "make_condition": "condition"}[name]
+    return None
+
+
+def _condition_wrapped(value: ast.Call) -> Optional[str]:
+    """For Condition(self._x) / make_condition(name, self._x): the
+    wrapped lock attr name."""
+    for a in list(value.args) + [kw.value for kw in value.keywords]:
+        if isinstance(a, ast.Attribute) \
+                and isinstance(a.value, ast.Name) and a.value.id == "self":
+            return a.attr
+    return None
+
+
+def _ann_type_name(ann) -> Optional[str]:
+    """Class name from a parameter annotation (Name or string forms
+    like "Runtime" / 'Optional["Runtime"]' — last identifier wins)."""
+    if ann is None:
+        return None
+    if isinstance(ann, ast.Name):
+        return ann.id
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        ident = "".join(c if (c.isalnum() or c == "_") else " "
+                        for c in ann.value).split()
+        return ident[-1] if ident else None
+    if isinstance(ann, ast.Attribute):
+        return ann.attr
+    return None
+
+
+def _collect_module_info(path: str, tree: ast.Module) -> _ModuleInfo:
+    name = os.path.splitext(os.path.basename(path))[0]
+    mi = _ModuleInfo(path, name)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                mi.imports[a.asname or a.name.split(".")[0]] = \
+                    a.name.split(".")[-1]
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                mi.imports[a.asname or a.name] = a.name
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            kind = _lock_ctor_kind(node.value)
+            if kind:
+                mi.module_locks[node.targets[0].id] = kind
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        mi.classes.add(node.name)
+        locks = mi.lock_attrs.setdefault(node.name, {})
+        types = mi.attr_types.setdefault(node.name, {})
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                t = sub.targets[0]
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "self"):
+                    continue
+                kind = _lock_ctor_kind(sub.value)
+                if kind == "condition":
+                    wrapped = _condition_wrapped(sub.value)
+                    locks[t.attr] = ("alias", wrapped) if wrapped \
+                        else "rlock"  # bare Condition() wraps an RLock
+                elif kind:
+                    locks[t.attr] = kind
+                elif isinstance(sub.value, ast.Call):
+                    f = sub.value.func
+                    ctor = f.attr if isinstance(f, ast.Attribute) else \
+                        f.id if isinstance(f, ast.Name) else ""
+                    if ctor and ctor[0].isupper():
+                        types[t.attr] = ctor
+                elif isinstance(sub.value, ast.Name):
+                    # self._rt = runtime  (resolved via the param
+                    # annotation of the enclosing function)
+                    fn = _enclosing_function(node, sub)
+                    if fn is not None:
+                        for arg in fn.args.args:
+                            if arg.arg == sub.value.id:
+                                tn = _ann_type_name(arg.annotation)
+                                if tn:
+                                    types[t.attr] = tn
+    return mi
+
+
+def _enclosing_function(cls: ast.ClassDef, stmt: ast.AST):
+    for item in cls.body:
+        if isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for sub in ast.walk(item):
+                if sub is stmt:
+                    return item
+    return None
+
+
+class _FunctionWalker(ast.NodeVisitor):
+    """Walks one function body tracking the with-held lock stack."""
+
+    def __init__(self, info: _FuncInfo, resolver: "_Resolver"):
+        self.info = info
+        self.res = resolver
+        self.held: List[LockId] = []
+
+    def visit_With(self, node: ast.With):
+        acquired: List[LockId] = []
+        for item in node.items:
+            lid = self.res.resolve_lock(self.info, item.context_expr)
+            if lid is not None:
+                for h in self.held:
+                    self.info.nest_edges.append((h, lid, node.lineno))
+                if lid in self.held \
+                        and self.res.lock_kind(lid) == "lock":
+                    self.info.nest_edges.append((lid, lid, node.lineno))
+                self.info.direct_acquires.add(lid)
+                self.info.acq_lines.setdefault(lid, node.lineno)
+                self.held.append(lid)
+                acquired.append(lid)
+        for stmt in node.body:
+            self.visit(stmt)
+        for lid in acquired:
+            self.held.remove(lid)
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call):
+        callee = self.res.resolve_call(self.info, node)
+        if callee is not None:
+            self.info.calls.add(callee)
+            for h in self.held:
+                self.info.held_calls.append((h, callee, node.lineno))
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        # Nested defs (closures/threads targets) run later, not under
+        # the current held stack — analyze them with an empty stack.
+        held, self.held = self.held, []
+        self.generic_visit(node)
+        self.held = held
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+
+class _Resolver:
+    def __init__(self, modules: Dict[str, _ModuleInfo],
+                 funcs: Dict[FuncId, _FuncInfo]):
+        self.modules = modules
+        self.funcs = funcs
+        # class name -> module info (first definition wins)
+        self.class_home: Dict[str, _ModuleInfo] = {}
+        for mi in modules.values():
+            for c in mi.classes:
+                self.class_home.setdefault(c, mi)
+
+    def _lock_kind_entry(self, scope: str, attr: str):
+        mi = self.class_home.get(scope)
+        if mi is not None:
+            return mi.lock_attrs.get(scope, {}).get(attr)
+        for m in self.modules.values():
+            if m.name == scope:
+                return m.module_locks.get(attr)
+        return None
+
+    def lock_kind(self, lid: LockId) -> str:
+        entry = self._lock_kind_entry(*lid)
+        if isinstance(entry, tuple):  # alias -> resolve
+            return self.lock_kind((lid[0], entry[1]))
+        return entry or "lock"
+
+    def canonical(self, scope: str, attr: str) -> Optional[LockId]:
+        entry = self._lock_kind_entry(scope, attr)
+        if entry is None:
+            return None
+        if isinstance(entry, tuple):
+            return self.canonical(scope, entry[1]) or (scope, attr)
+        return (scope, attr)
+
+    def resolve_lock(self, info: _FuncInfo,
+                     expr: ast.expr) -> Optional[LockId]:
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name):
+            base = expr.value.id
+            if base == "self" and info.cls:
+                return self.canonical(info.cls, expr.attr)
+            # with actor.lock:  (param with a known annotated type)
+            tn = self._local_type(info, base)
+            if tn:
+                return self.canonical(tn, expr.attr)
+            return None
+        if isinstance(expr, ast.Name):
+            if expr.id in info.module.module_locks:
+                return self.canonical(info.module.name, expr.id)
+        return None
+
+    def _local_type(self, info: _FuncInfo, name: str) -> Optional[str]:
+        node = info.node
+        if node is None or not hasattr(node, "args"):
+            return None
+        for arg in list(node.args.args) + list(node.args.kwonlyargs):
+            if arg.arg == name:
+                return _ann_type_name(arg.annotation)
+        return None
+
+    def _method(self, cls: Optional[str], name: str) -> Optional[FuncId]:
+        if cls is None:
+            return None
+        fid = (cls, name)
+        return fid if fid in self.funcs else None
+
+    def resolve_call(self, info: _FuncInfo,
+                     node: ast.Call) -> Optional[FuncId]:
+        f = node.func
+        if isinstance(f, ast.Name):
+            # Same-module function or a class constructor.
+            fid = (info.module.name, f.id)
+            if fid in self.funcs:
+                return fid
+            return self._method(f.id, "__init__")
+        if not isinstance(f, ast.Attribute):
+            return None
+        recv = f.value
+        if isinstance(recv, ast.Name):
+            if recv.id == "self" and info.cls:
+                m = self._method(info.cls, f.attr)
+                if m:
+                    return m
+                # self.attr as a typed object? (self.shm.get is the
+                # Attribute-receiver case below)
+                return None
+            # module alias:  metrics_mod.inc(...)
+            target_mod = info.module.imports.get(recv.id)
+            if target_mod:
+                fid = (target_mod, f.attr)
+                if fid in self.funcs:
+                    return fid
+            # annotated local/param:  actor.stop()
+            tn = self._local_type(info, recv.id)
+            if tn:
+                return self._method(tn, f.attr)
+            return None
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self" and info.cls:
+            # self.<attr>.<method>() with a known attr type.
+            mi = self.class_home.get(info.cls)
+            tn = None
+            if mi is not None:
+                tn = mi.attr_types.get(info.cls, {}).get(recv.attr)
+            if tn:
+                return self._method(tn, f.attr)
+        return None
+
+
+def analyze_lock_order(files) -> LockGraph:
+    """Build the lock graph over `files` and report cycles (GC201) and
+    guaranteed self-deadlocks (GC203) as findings."""
+    modules: Dict[str, _ModuleInfo] = {}
+    trees: Dict[str, ast.Module] = {}
+    for path in files:
+        try:
+            with open(path, "rb") as fh:
+                tree = ast.parse(fh.read().decode("utf-8",
+                                                  errors="replace"),
+                                 filename=path)
+        except (SyntaxError, OSError):
+            continue  # run_lint reports parse failures
+        mi = _collect_module_info(path, tree)
+        modules[path] = mi
+        trees[path] = tree
+
+    funcs: Dict[FuncId, _FuncInfo] = {}
+    for path, tree in trees.items():
+        mi = modules[path]
+        for node in tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                funcs.setdefault((mi.name, node.name),
+                                 _FuncInfo((mi.name, node.name), mi,
+                                           None, node))
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        funcs.setdefault(
+                            (node.name, item.name),
+                            _FuncInfo((node.name, item.name), mi,
+                                      node.name, item))
+
+    resolver = _Resolver(modules, funcs)
+    for info in funcs.values():
+        walker = _FunctionWalker(info, resolver)
+        for stmt in info.node.body:
+            walker.visit(stmt)
+
+    # Transitive acquires to a fixpoint over the call graph.
+    trans: Dict[FuncId, Set[LockId]] = {
+        fid: set(fi.direct_acquires) for fid, fi in funcs.items()}
+    changed = True
+    while changed:
+        changed = False
+        for fid, fi in funcs.items():
+            cur = trans[fid]
+            before = len(cur)
+            for callee in fi.calls:
+                cur |= trans.get(callee, set())
+            if len(cur) != before:
+                changed = True
+
+    graph = LockGraph()
+    for lid in {l for s in trans.values() for l in s}:
+        graph.lock_kinds[lid] = resolver.lock_kind(lid)
+    self_deadlocks: List[Tuple[LockId, str, int]] = []
+    for fid, fi in funcs.items():
+        rp = relpath(fi.module.path)
+        for a, b, line in fi.nest_edges:
+            if a == b:
+                self_deadlocks.append((a, rp, line))
+            else:
+                graph.add_edge(a, b, rp, line)
+        for held, callee, line in fi.held_calls:
+            for inner in trans.get(callee, ()):
+                if inner == held:
+                    if graph.lock_kinds.get(held) == "lock":
+                        self_deadlocks.append((held, rp, line))
+                    continue
+                graph.add_edge(held, inner, rp, line)
+
+    for lid, rp, line in sorted(set(self_deadlocks)):
+        graph.findings.append(Finding(
+            rule="GC203", path=rp, line=line, severity=SEVERITY_ERROR,
+            message=(f"non-reentrant lock {lid[0]}.{lid[1]} may be "
+                     f"re-acquired while already held on this path "
+                     f"(guaranteed self-deadlock)"),
+            context=f"{lid[0]}.{lid[1]}"))
+
+    for cyc in graph.cycles():
+        names = " -> ".join(f"{c}.{a}" for c, a in cyc + [cyc[0]])
+        sites = []
+        for i, a in enumerate(cyc):
+            b = cyc[(i + 1) % len(cyc)]
+            site = graph.edges.get((a, b))
+            if site:
+                sites.append(f"{site[0]}:{site[1]}")
+        first = graph.edges.get((cyc[0], cyc[1 % len(cyc)]),
+                                ("<unknown>", 1))
+        graph.findings.append(Finding(
+            rule="GC201", path=first[0], line=first[1],
+            severity=SEVERITY_ERROR,
+            message=(f"lock-order cycle (potential deadlock): {names}; "
+                     f"acquisition sites: {', '.join(sites)}"),
+            context=names))
+    return graph

@@ -1,0 +1,38 @@
+"""Finding output: human text and machine JSON."""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import List
+
+from .findings import Finding
+
+
+def print_text(new: List[Finding], suppressed: List[Finding],
+               stream=None) -> None:
+    stream = stream or sys.stdout
+    for f in new:
+        print(f.render(), file=stream)
+    by_rule = {}
+    for f in new:
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    if new:
+        breakdown = ", ".join(f"{r}={n}" for r, n in sorted(by_rule.items()))
+        print(f"graftcheck: {len(new)} finding(s) ({breakdown})"
+              + (f"; {len(suppressed)} suppressed" if suppressed else ""),
+              file=stream)
+    else:
+        print("graftcheck: clean"
+              + (f" ({len(suppressed)} suppressed)" if suppressed else ""),
+              file=stream)
+
+
+def print_json(new: List[Finding], suppressed: List[Finding],
+               stream=None) -> None:
+    stream = stream or sys.stdout
+    json.dump({
+        "findings": [f.to_dict() for f in new],
+        "suppressed": [f.to_dict() for f in suppressed],
+    }, stream, indent=2, sort_keys=True)
+    stream.write("\n")

@@ -1,0 +1,228 @@
+"""Rule framework: per-module AST context + the lint-rule registry.
+
+Each rule is a class with `id`, `severity`, `doc`, and
+`check(ctx) -> iterable[Finding]`. Rules are framework-aware: the
+ModuleContext pre-resolves what the rest of the tree would have to
+re-derive — which names alias the ``ray_tpu`` package, which
+functions/classes carry ``@ray_tpu.remote``, the AST parent map, and
+the enclosing-scope qualname for any node (baseline stability).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, List, Optional, Set
+
+from .findings import (Finding, SEVERITY_ERROR, load_inline_suppressions,
+                       relpath)
+
+RULE_REGISTRY: List[type] = []
+
+
+def register(cls):
+    RULE_REGISTRY.append(cls)
+    return cls
+
+
+class Rule:
+    id = "GC000"
+    severity = SEVERITY_ERROR
+    doc = ""
+
+    def check(self, ctx: "ModuleContext") -> Iterable[Finding]:
+        raise NotImplementedError
+
+
+class ModuleContext:
+    """One parsed module + the resolved facts rules share."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.relpath = relpath(path)
+        self.source = source
+        self.tree = tree
+        self.parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(tree):
+            for child in ast.iter_child_nodes(node):
+                self.parents[child] = node
+        self.ray_aliases = self._collect_ray_aliases()
+        self.remote_bare_names = self._collect_remote_bare_names()
+        file_rules, line_rules = load_inline_suppressions(source)
+        self._file_suppressions = file_rules
+        self._line_suppressions = line_rules
+
+    # -- suppression ---------------------------------------------------
+    def suppressed(self, rule_id: str, line: int) -> bool:
+        if rule_id in self._file_suppressions:
+            return True
+        return rule_id in self._line_suppressions.get(line, ())
+
+    # -- alias resolution ----------------------------------------------
+    def _collect_ray_aliases(self) -> Set[str]:
+        aliases = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name in ("ray_tpu", "ray"):
+                        aliases.add(a.asname or a.name)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module in ("ray_tpu", "ray"):
+                    # `from ray_tpu import remote` handled separately.
+                    pass
+        return aliases
+
+    def _collect_remote_bare_names(self) -> Set[str]:
+        """Names under which `remote` itself was imported
+        (`from ray_tpu import remote`)."""
+        names = set()
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) \
+                    and node.module in ("ray_tpu", "ray"):
+                for a in node.names:
+                    if a.name == "remote":
+                        names.add(a.asname or "remote")
+        return names
+
+    def is_remote_decorator(self, dec: ast.expr) -> bool:
+        """Matches @ray_tpu.remote, @ray_tpu.remote(...), and the
+        bare @remote forms when `remote` was imported from ray_tpu."""
+        if isinstance(dec, ast.Call):
+            dec = dec.func
+        if isinstance(dec, ast.Attribute) and dec.attr == "remote" \
+                and isinstance(dec.value, ast.Name) \
+                and dec.value.id in self.ray_aliases:
+            return True
+        return (isinstance(dec, ast.Name)
+                and dec.id in self.remote_bare_names)
+
+    def is_remote_def(self, node) -> bool:
+        return isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)) \
+            and any(self.is_remote_decorator(d)
+                    for d in node.decorator_list)
+
+    def iter_remote_callables(self):
+        """Yield (def_node, owner) for every remote function and every
+        method of a remote class; owner is the ClassDef or None."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and self.is_remote_def(node):
+                yield node, None
+            elif isinstance(node, ast.ClassDef) \
+                    and self.is_remote_def(node):
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        yield item, node
+
+    # -- scope naming --------------------------------------------------
+    def qualname(self, node: ast.AST) -> str:
+        parts = []
+        cur: Optional[ast.AST] = node
+        while cur is not None:
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                parts.append(cur.name)
+            cur = self.parents.get(cur)
+        return ".".join(reversed(parts))
+
+    def finding(self, rule: "Rule", node: ast.AST, message: str,
+                context_node: Optional[ast.AST] = None) -> Finding:
+        line = getattr(node, "lineno", 1)
+        return Finding(
+            rule=rule.id, path=self.relpath, line=line,
+            severity=rule.severity, message=message,
+            context=self.qualname(context_node or node),
+            inline_suppressed=self.suppressed(rule.id, line))
+
+
+def const_size(node: ast.expr) -> int:
+    """Rough 'size' of a literal expression: element count plus the
+    length of string/bytes constants, recursing into containers.
+    Non-constant parts contribute nothing (under-approximation)."""
+    if isinstance(node, ast.Constant):
+        v = node.value
+        if isinstance(v, (str, bytes)):
+            return len(v)
+        return 1
+    if isinstance(node, (ast.List, ast.Tuple, ast.Set)):
+        return len(node.elts) + sum(const_size(e) for e in node.elts)
+    if isinstance(node, ast.Dict):
+        total = len(node.values)
+        for k in node.keys:
+            if k is not None:
+                total += const_size(k)
+        return total + sum(const_size(v) for v in node.values)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Mult):
+        # `[0] * 1000000` / `b"x" * (1 << 20)`: literal repetition.
+        left, right = node.left, node.right
+        factor = _int_value(right)
+        base = const_size(left)
+        if factor is None:
+            factor = _int_value(left)
+            base = const_size(right)
+        if factor is not None and base:
+            return base * factor
+    return 0
+
+
+def _int_value(node: ast.expr) -> Optional[int]:
+    try:
+        v = ast.literal_eval(node)
+    except (ValueError, SyntaxError):
+        return None
+    return v if isinstance(v, int) and v >= 0 else None
+
+
+def iter_py_files(paths) -> List[str]:
+    files: List[str] = []
+    seen = set()
+    for p in paths:
+        if os.path.isdir(p):
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d != "__pycache__" and not d.startswith("."))
+                for name in sorted(names):
+                    if name.endswith(".py"):
+                        files.append(os.path.join(root, name))
+        elif p.endswith(".py"):
+            files.append(p)
+    out = []
+    for f in files:
+        ap = os.path.abspath(f)
+        if ap not in seen:
+            seen.add(ap)
+            out.append(f)
+    return out
+
+
+def parse_module(path: str) -> Optional[ModuleContext]:
+    """Parse one file; a syntax error surfaces as a GC001 finding via
+    run_lint rather than aborting the whole run."""
+    with open(path, "rb") as f:
+        source_bytes = f.read()
+    source = source_bytes.decode("utf-8", errors="replace")
+    tree = ast.parse(source, filename=path)
+    return ModuleContext(path, source, tree)
+
+
+def run_lint(files) -> List[Finding]:
+    """Run every registered rule over `files` (paths, pre-expanded)."""
+    from . import lint_rules  # noqa: F401 — registers the rules
+    findings: List[Finding] = []
+    rules = [cls() for cls in RULE_REGISTRY]
+    for path in files:
+        try:
+            ctx = parse_module(path)
+        except (SyntaxError, OSError) as e:
+            findings.append(Finding(
+                rule="GC001", path=relpath(path),
+                line=getattr(e, "lineno", None) or 1,
+                severity=SEVERITY_ERROR,
+                message=f"could not parse module: {e}"))
+            continue
+        for rule in rules:
+            findings.extend(rule.check(ctx))
+    return findings

@@ -1,0 +1,177 @@
+"""Env-gated runtime lock-order tracer (``RAY_TPU_LOCKCHECK=1``).
+
+The static graph (lockgraph.py) cannot see dynamic edges — callbacks,
+serialize hooks, cross-process handler re-entry. This module closes
+that gap at test time: when the env knob is set, the lock factories
+below return traced wrappers that record, per thread, which locks are
+held when another is acquired. Observing lock B acquired under A on
+one path and A under B on another is an inversion — the interleaving
+that deadlocks may not have happened yet, but the order violation is
+already proven. Violations are collected (``get_violations()``), and
+tests assert the list stays empty.
+
+Granularity is per SITE (the name passed at construction, e.g.
+``"Runtime._owned_lock"``), matching the static analysis: orders
+between two instances of the same site are not checked (two
+``_TransferPool._lock`` instances are routinely held together).
+
+With the knob unset the factories return plain ``threading`` objects —
+zero overhead in production.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_tls = threading.local()
+_reg_lock = threading.Lock()
+# (first, then) -> (thread name, site description)
+_orders: Dict[Tuple[str, str], str] = {}
+_violations: List[dict] = []
+
+_ENABLED: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """The env knob, read once per process (tests use reset_state()
+    after flipping it)."""
+    global _ENABLED
+    if _ENABLED is None:
+        from .. import config
+        _ENABLED = bool(config.get("RAY_TPU_LOCKCHECK"))
+    return _ENABLED
+
+
+def reset_state() -> None:
+    """Test helper: clear recorded orders/violations and re-read the
+    env knob."""
+    global _ENABLED
+    _ENABLED = None
+    with _reg_lock:
+        _orders.clear()
+        _violations.clear()
+
+
+def get_violations() -> List[dict]:
+    with _reg_lock:
+        return list(_violations)
+
+
+def _held_stack() -> List[str]:
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    return stack
+
+
+def _note_acquire(name: str) -> None:
+    stack = _held_stack()
+    tname = threading.current_thread().name
+    with _reg_lock:
+        for held in stack:
+            if held == name:
+                continue  # same-site pair: instance order not checked
+            pair = (held, name)
+            if pair not in _orders:
+                _orders[pair] = tname
+            inverse = _orders.get((name, held))
+            if inverse is not None:
+                _violations.append({
+                    "rule": "GC202",
+                    "first": name, "second": held,
+                    "message": (
+                        f"lock-order inversion: {held!r} held while "
+                        f"acquiring {name!r} on thread {tname!r}, but "
+                        f"the opposite order {name!r} -> {held!r} was "
+                        f"recorded on thread {inverse!r}"),
+                })
+    stack.append(name)
+
+
+def _note_release(name: str) -> None:
+    stack = _held_stack()
+    # Condition.wait releases out of LIFO order: drop the LAST
+    # occurrence, wherever it sits.
+    for i in range(len(stack) - 1, -1, -1):
+        if stack[i] == name:
+            del stack[i]
+            return
+
+
+class TracedLock:
+    """threading.Lock wrapper recording acquisition order by site."""
+
+    _reentrant = False
+
+    def __init__(self, name: str, inner=None):
+        self.name = name
+        self._inner = inner if inner is not None else threading.Lock()
+        # Per-thread hold depth for reentrant wrappers.
+        self._depth = threading.local()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if self._reentrant:
+            depth = getattr(self._depth, "n", 0)
+            ok = self._inner.acquire(blocking, timeout)
+            if ok:
+                self._depth.n = depth + 1
+                if depth == 0:
+                    _note_acquire(self.name)
+            return ok
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            _note_acquire(self.name)
+        return ok
+
+    def release(self):
+        if self._reentrant:
+            depth = getattr(self._depth, "n", 1)
+            self._depth.n = depth - 1
+            if depth == 1:
+                _note_release(self.name)
+        else:
+            _note_release(self.name)
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    def locked(self):
+        return self._inner.locked()
+
+
+class TracedRLock(TracedLock):
+    _reentrant = True
+
+    def __init__(self, name: str):
+        super().__init__(name, inner=threading.RLock())
+
+
+def make_lock(name: str):
+    """Factory the runtime modules use for every mutex: a plain
+    threading.Lock normally, a traced wrapper under RAY_TPU_LOCKCHECK."""
+    if enabled():
+        return TracedLock(name)
+    return threading.Lock()
+
+
+def make_rlock(name: str):
+    if enabled():
+        return TracedRLock(name)
+    return threading.RLock()
+
+
+def make_condition(name: str, lock=None):
+    """Condition over a (possibly traced) lock. With no `lock`, the
+    condition gets its own traced RLock so waits/notifies still record."""
+    if not enabled():
+        return threading.Condition(lock)
+    if lock is None:
+        lock = TracedRLock(name)
+    return threading.Condition(lock)

@@ -200,6 +200,7 @@ class HeadServer:
                 "tcp://127.0.0.1:0", self._handle,
                 on_connect=self._on_connect, on_close=self._on_conn_close)
             self.tcp_addr = self.tcp_server.path
+        self._log_tailer = None
         self._monitor_thread = threading.Thread(
             target=self._monitor_loop, daemon=True, name="head-monitor")
         self._monitor_thread.start()
@@ -1347,3 +1348,17 @@ class HeadServer:
         self.server.close()
         if self.tcp_server is not None:
             self.tcp_server.close()
+        # Stop and join the head's own service threads so repeated
+        # init()/shutdown() in one process does not leak them.
+        if self._metrics_http is not None:
+            try:
+                self._metrics_http.shutdown()
+                self._metrics_http.server_close()
+            except Exception:
+                logger.warning("metrics http shutdown failed",
+                               exc_info=True)
+        if self._log_tailer is not None:
+            self._log_tailer.stop()
+            self._log_tailer.join(timeout=1.0)
+        if self._monitor_thread is not threading.current_thread():
+            self._monitor_thread.join(timeout=2.0)

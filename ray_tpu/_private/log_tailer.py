@@ -11,10 +11,13 @@ their origin.
 from __future__ import annotations
 
 import glob
+import logging
 import os
 import threading
 import time
 from typing import Callable, Dict
+
+logger = logging.getLogger(__name__)
 
 # Per-file, per-tick read cap: a worker spewing output cannot wedge the
 # tailer or flood the control plane.
@@ -41,7 +44,10 @@ class LogTailer(threading.Thread):
             try:
                 self.poll_once()
             except Exception:
-                pass
+                # Keep tailing on transient IO/publish failures, but
+                # leave a trace — a permanently failing poll otherwise
+                # looks exactly like "no worker output".
+                logger.warning("log tailer poll failed", exc_info=True)
             self._stopped.wait(self.interval_s)
 
     def poll_once(self):

@@ -54,8 +54,9 @@ class NodeAgent:
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
         # SIGUSR1 -> all-thread stack dump (debug.py; the runtime's
         # TSAN/gdb-attach analog for wedged daemons).
-        from .debug import install_signal_dump
+        from .debug import install_signal_dump, install_thread_excepthook
         install_signal_dump()
+        install_thread_excepthook()
 
         self.head = protocol.connect(
             head_addr, f"agent:{node_id}", self._handle,
@@ -189,6 +190,15 @@ class NodeAgent:
                 f"{self.session_name}_{self.node_id}").cleanup_session()
         except Exception:
             pass
+        # Join this agent's service threads (shutdown may be invoked
+        # from the head connection's recv thread via _on_head_close —
+        # never join the calling thread itself).
+        if self._log_tailer is not None:
+            self._log_tailer.stop()
+            if self._log_tailer is not threading.current_thread():
+                self._log_tailer.join(timeout=1.0)
+        if self._monitor_thread is not threading.current_thread():
+            self._monitor_thread.join(timeout=2.0)
 
     def wait(self):
         self._shutdown.wait()

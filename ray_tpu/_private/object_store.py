@@ -23,6 +23,7 @@ import threading
 from typing import Dict, Optional
 
 from . import serialization
+from .graftcheck.runtime_trace import make_condition, make_lock
 from .ids import ObjectID
 
 from . import config as _config
@@ -47,8 +48,8 @@ class MemoryStore:
 
     def __init__(self):
         self._objects: Dict[ObjectID, object] = {}
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = make_lock("MemoryStore._lock")
+        self._cv = make_condition("MemoryStore._cv", self._lock)
 
     def put(self, oid: ObjectID, value) -> None:
         with self._cv:
@@ -167,7 +168,7 @@ class SharedObjectStore:
         self.prefix = os.path.join(SHM_DIR, f"raytpu_{session_name}_")
         # Pins: mmaps we must keep open because deserialized values alias them.
         self._pins: Dict[ObjectID, _Pin] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("SharedObjectStore._lock")
 
     def _path(self, oid: ObjectID) -> str:
         return self.prefix + oid.hex()

@@ -26,6 +26,8 @@ import struct
 import threading
 from typing import Callable, Dict, Optional
 
+from .graftcheck.runtime_trace import make_lock
+
 logger = logging.getLogger(__name__)
 
 _LEN = struct.Struct("<Q")
@@ -184,9 +186,9 @@ class Connection:
         self.peer_addr = peer_addr  # advertised server address of the peer
         self.on_close = on_close
         self.closed = False
-        self._send_lock = threading.Lock()
+        self._send_lock = make_lock("Connection._send_lock")
         self._seq = 0
-        self._seq_lock = threading.Lock()
+        self._seq_lock = make_lock("Connection._seq_lock")
         self._pending: Dict[int, _ReplyFuture] = {}
         self._thread = threading.Thread(
             target=self._recv_loop, daemon=True, name=f"conn-recv-{peer_addr}")
@@ -268,6 +270,13 @@ class Connection:
             return
         self.closed = True
         try:
+            # close() alone does NOT unblock another thread sitting in
+            # recv() on this socket (the fd stays referenced); shutdown
+            # forces the recv loop out so it can be joined.
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self.sock.close()
         except OSError:
             pass
@@ -281,6 +290,12 @@ class Connection:
 
     def close(self):
         self._handle_close()
+        # The closed socket unblocks the recv loop immediately; join it
+        # so repeated connect/close cycles don't accumulate threads
+        # (close() may run ON the recv thread via _handle_close's
+        # finally — joining yourself is a no-op guard).
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=1.0)
 
 
 class Server:
@@ -322,7 +337,7 @@ class Server:
         # transfer retry, not a peer death) must not trigger the
         # server's on_close peer-cleanup.
         self.transfer_connections: list = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("Server._lock")
         self._stopped = False
         self._thread = threading.Thread(
             target=self._accept_loop, daemon=True, name=f"server-{path}")
@@ -376,6 +391,12 @@ class Server:
     def close(self):
         self._stopped = True
         try:
+            # shutdown() (not just close) is what actually unblocks the
+            # accept loop's blocking accept() on Linux.
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
             self._sock.close()
         except OSError:
             pass
@@ -384,6 +405,8 @@ class Server:
                 + list(self.transfer_connections)
         for c in conns:
             c.close()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=1.0)
         if not is_tcp(self.path) and os.path.exists(self.path):
             try:
                 os.unlink(self.path)

@@ -38,6 +38,8 @@ from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
 from . import config
 from . import object_ref as object_ref_mod
 from . import protocol, serialization, task_events
+from .graftcheck.runtime_trace import (make_condition, make_lock,
+                                       make_rlock)
 from .ids import ActorID, JobID, ObjectID, TaskID
 from .object_ref import ObjectRef
 from .object_store import INLINE_OBJECT_MAX, MemoryStore, SharedObjectStore
@@ -69,7 +71,7 @@ class _SendTicket:
         self.encoder = encoder
         self.wire_bytes = 0
         self.raw_bytes = 0
-        self._cv = threading.Condition()
+        self._cv = make_condition("_SendTicket._cv")
         self._outstanding = 0
         self.failed: list = []
         self.exc: Optional[BaseException] = None
@@ -125,7 +127,15 @@ class _StripeWorker:
 
     def _loop(self):
         while True:
-            item = self.q.get()
+            try:
+                item = self.q.get(timeout=0.5)
+            except queue.Empty:
+                # Bounded wait so a stop() whose sentinel could not be
+                # queued (queue full at the time) still terminates the
+                # thread promptly.
+                if not self.alive:
+                    return
+                continue
             if item is None:
                 return
             ticket = item[0]
@@ -150,7 +160,7 @@ class _StripeWorker:
                         pass
                 return
 
-    def stop(self):
+    def stop(self, join_timeout: float = 0.0):
         self.alive = False
         try:
             self.q.put_nowait(None)
@@ -161,6 +171,9 @@ class _StripeWorker:
                 self.conn.close()
             except Exception:
                 pass
+        if join_timeout > 0 \
+                and self.thread is not threading.current_thread():
+            self.thread.join(timeout=join_timeout)
 
 
 class _TransferPool:
@@ -187,7 +200,7 @@ class _TransferPool:
     def __init__(self, runtime: "Runtime", addr: str):
         self._rt = runtime
         self.addr = addr
-        self._lock = threading.Lock()
+        self._lock = make_lock("_TransferPool._lock")
         self._workers: List[_StripeWorker] = []
         self._target = max(0, config.get("RAY_TPU_TRANSFER_STREAMS"))
         self._dial_fail_until = 0.0
@@ -200,7 +213,7 @@ class _TransferPool:
         # on small boxes every thread hop costs scheduler latency.
         # Contended senders take the worker path, so the r5 lock-convoy
         # of many threads on one connection cannot re-form.
-        self._inline_mutex = threading.Lock()
+        self._inline_mutex = make_lock("_TransferPool._inline_mutex")
 
     # -- connections ---------------------------------------------------
     def _ensure_workers(self) -> List[_StripeWorker]:
@@ -249,7 +262,7 @@ class _TransferPool:
             self._closed = True
             workers, self._workers = self._workers, []
         for w in workers:
-            w.stop()
+            w.stop(join_timeout=1.0)
 
     # -- sending -------------------------------------------------------
     def _send_item(self, conn, item):
@@ -460,7 +473,7 @@ class _RefTracker:
         import queue as _queue
         self._rt = runtime
         self._counts: Dict[ObjectID, int] = {}
-        self._lock = threading.RLock()
+        self._lock = make_rlock("_RefTracker._lock")
         self._notify_q: "_queue.SimpleQueue" = _queue.SimpleQueue()
         self._notify_thread = threading.Thread(
             target=self._notify_loop, daemon=True, name="borrow-notify")
@@ -486,6 +499,14 @@ class _RefTracker:
     def count(self, oid: ObjectID) -> int:
         with self._lock:
             return self._counts.get(oid, 0)
+
+    def stop(self, timeout: float = 2.0):
+        """Terminate the notify thread (sentinel is FIFO-ordered behind
+        every already-queued notification, so pending deliveries still
+        attempt once before exit)."""
+        self._notify_q.put(None)
+        if self._notify_thread is not threading.current_thread():
+            self._notify_thread.join(timeout=timeout)
 
     def note_export(self, oid: ObjectID, owner_addr: str):
         """Called when a ref we OWN is pickled for a peer: a borrower's
@@ -551,8 +572,10 @@ class _RefTracker:
                 timeout = max(0.0, min(d for d, _ in retry_at.values())
                               - time.monotonic())
             try:
-                owner_addr, kind, oid = self._notify_q.get(
-                    timeout=timeout)
+                item = self._notify_q.get(timeout=timeout)
+                if item is None:
+                    return  # stop() sentinel
+                owner_addr, kind, oid = item
                 pending.setdefault(owner_addr, deque()).append(
                     (kind, oid))
                 if owner_addr not in retry_at:
@@ -595,9 +618,10 @@ class _Batcher:
     def __init__(self, get_conn, on_fail=None):
         self._get_conn = get_conn
         self._on_fail = on_fail  # (addr, msgs, exc) after a failed send
-        self._lock = threading.Lock()
-        self._cv = threading.Condition(self._lock)
+        self._lock = make_lock("_Batcher._lock")
+        self._cv = make_condition("_Batcher._cv", self._lock)
         self._pending: deque = deque()
+        self._stopped = False
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="send-batcher")
         self._thread.start()
@@ -607,11 +631,22 @@ class _Batcher:
             self._pending.append((addr, msg))
             self._cv.notify()
 
+    def stop(self, timeout: float = 2.0) -> None:
+        """Drain what is queued, then terminate the drain thread (call
+        while connections are still open so final messages ship)."""
+        with self._lock:
+            self._stopped = True
+            self._cv.notify()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=timeout)
+
     def _loop(self):
         while True:
             with self._lock:
-                while not self._pending:
+                while not self._pending and not self._stopped:
                     self._cv.wait()
+                if self._stopped and not self._pending:
+                    return
                 batch = list(self._pending)
                 self._pending.clear()
             by_addr: Dict[str, list] = {}
@@ -680,20 +715,22 @@ class ActorState:
         self.spec = spec
         self.instance = instance
         self.streams: Dict[str, dict] = {}  # caller addr -> {next, buffer}
-        self.lock = threading.Lock()
+        self.lock = make_lock("ActorState.lock")
         self.checkpointable = _is_checkpointable(instance)
-        self.checkpoint_lock = threading.Lock()
+        self.checkpoint_lock = make_lock("ActorState.checkpoint_lock")
         self.tasks_since_checkpoint = 0
         self.last_checkpoint_id = None
         self.last_checkpoint_ts = None
         if spec.is_asyncio:
             self.loop = asyncio.new_event_loop()
             self.sem = None  # created on the loop
-            threading.Thread(target=self._run_loop, daemon=True,
-                             name="actor-asyncio").start()
+            self.loop_thread = threading.Thread(
+                target=self._run_loop, daemon=True, name="actor-asyncio")
+            self.loop_thread.start()
             self.executor = None
         else:
             self.loop = None
+            self.loop_thread = None
             self.executor = ThreadPoolExecutor(
                 max_workers=max(1, spec.max_concurrency),
                 thread_name_prefix="actor-exec")
@@ -702,6 +739,17 @@ class ActorState:
         asyncio.set_event_loop(self.loop)
         self.sem = asyncio.Semaphore(max(1, self.spec.max_concurrency))
         self.loop.run_forever()
+
+    def stop(self):
+        if self.loop is not None:
+            try:
+                self.loop.call_soon_threadsafe(self.loop.stop)
+            except RuntimeError:
+                pass  # loop already closed
+            if self.loop_thread is not None:
+                self.loop_thread.join(timeout=2.0)
+        elif self.executor is not None:
+            self.executor.shutdown(wait=False)
 
 
 class Runtime:
@@ -713,6 +761,11 @@ class Runtime:
         self.role = role
         self.session_dir = session_dir
         self.session_name = session_name
+        # Service threads must not die silently (satellite of the
+        # graftcheck work): uncaught exceptions log, count, and surface
+        # on the driver's error stream.
+        from .debug import install_thread_excepthook
+        install_thread_excepthook()
         self.node_id = node_id or os.environ.get("RAY_TPU_NODE_ID", "node0")
         # In a multi-node session (head reached over TCP) every process
         # serves on TCP so peers on other nodes can dial it; single-node
@@ -744,7 +797,7 @@ class Runtime:
         self._owned_bytes = 0
         self._owned_shm_bytes = 0
         self._owned_shm: Set[ObjectID] = set()
-        self._owned_lock = threading.Lock()
+        self._owned_lock = make_lock("Runtime._owned_lock")
         # Registered borrows, PER PEER (oid -> {peer_addr: count}):
         # per-peer floors make a stray remove_borrow (e.g. after its
         # add_borrow was dropped toward an unreachable owner) unable to
@@ -798,31 +851,31 @@ class Runtime:
         # (offsets and bookkeeping only; stripe bytes pwrite directly
         # into the pre-sized store destination).
         self._chunk_buf: Dict[ObjectID, _InboundTransfer] = {}
-        self._chunk_lock = threading.Lock()
+        self._chunk_lock = make_lock("Runtime._chunk_lock")
         self._chunk_size = int(config.get("RAY_TPU_OBJECT_CHUNK_SIZE"))
         self._stripe_min = int(config.get("RAY_TPU_WIRE_STRIPE_MIN"))
 
         self._conns: Dict[str, protocol.Connection] = {}
-        self._conns_lock = threading.Lock()
+        self._conns_lock = make_lock("Runtime._conns_lock")
         # Striped data plane, one pool of transfer connections per peer.
         self._transfer_pools: Dict[str, _TransferPool] = {}
         # Bounded parallel-fetch executor for multi-ref get()/wait().
         self._fetch_pool: Optional[ThreadPoolExecutor] = None
-        self._fetch_lock = threading.Lock()
+        self._fetch_lock = make_lock("Runtime._fetch_lock")
         self._fn_cache: Dict[str, object] = {}
         self._exported: Set[str] = set()
-        self._export_lock = threading.Lock()
+        self._export_lock = make_lock("Runtime._export_lock")
 
         # Actor-client state.
         self._actor_cache: Dict[ActorID, dict] = {}
         self._actor_events: Dict[ActorID, threading.Event] = {}
         self._actor_seqs: Dict[Tuple[ActorID], int] = {}
-        self._seq_lock = threading.Lock()
+        self._seq_lock = make_lock("Runtime._seq_lock")
         # Actor tasks in flight per destination addr, to fail them fast on
         # connection loss (reference: CoreWorkerDirectActorTaskSubmitter
         # marks tasks failed on DisconnectClient).
         self._pending_to_addr: Dict[str, Dict[TaskID, TaskSpec]] = {}
-        self._pending_lock = threading.Lock()
+        self._pending_lock = make_lock("Runtime._pending_lock")
         # Submitted-task arg pins (released when the first result lands).
         self._task_arg_pins: Dict[TaskID, list] = {}
         self._actor_creation_tasks: Dict[ActorID, TaskID] = {}
@@ -830,14 +883,14 @@ class Runtime:
         # Objects another process asked for before they were ready: owner
         # forwards the result when it arrives.
         self._object_waiters: Dict[ObjectID, Set[str]] = {}
-        self._waiters_lock = threading.Lock()
+        self._waiters_lock = make_lock("Runtime._waiters_lock")
         self._fetching: Set[ObjectID] = set()
 
         # Worker leases (reference: `direct_task_transport.h:36,68,89`):
         # once a lease is granted, normal tasks of that resource shape go
         # caller->worker directly, pipelined, with the head out of the
         # per-task path entirely.
-        self._lease_lock = threading.Lock()
+        self._lease_lock = make_lock("Runtime._lease_lock")
         self._lease_groups: Dict[tuple, "_LeaseGroup"] = {}
         self._lease_by_addr: Dict[str, tuple] = {}  # worker -> group key
         self._leased_pending: Dict[str, Dict[TaskID, TaskSpec]] = {}
@@ -862,6 +915,7 @@ class Runtime:
             "RAY_TPU_LEASE_FAST_TASK_MAX_LEASES"))
         self._lease_linger_s = config.get("RAY_TPU_LEASE_LINGER_S")
         self._lease_sweeper_started = False
+        self._lease_sweeper_thread: Optional[threading.Thread] = None
 
         # Lineage-lite (reference: owner-side retries,
         # `src/ray/core_worker/task_manager.h:29` — NOT the legacy
@@ -878,17 +932,18 @@ class Runtime:
         # "is anything producing this object?" without asking the head.
         self._inflight_tasks: Dict[TaskID, int] = {}
         self._freed_returns: Dict[TaskID, Set[ObjectID]] = {}
-        self._lineage_lock = threading.Lock()
+        self._lineage_lock = make_lock("Runtime._lineage_lock")
         self._lineage_max = config.get("RAY_TPU_LINEAGE_MAX_SPECS")
 
         # Worker-side execution state.
         from .memory_monitor import MemoryMonitor
         self._memory_monitor = MemoryMonitor()
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
+        self._task_thread: Optional[threading.Thread] = None
         self._actor: Optional[ActorState] = None
         # Actor calls that arrived before __init__ finished.
         self._pre_actor_tasks: List[TaskSpec] = []
-        self._pre_actor_lock = threading.Lock()
+        self._pre_actor_lock = make_lock("Runtime._pre_actor_lock")
         self._shutdown_event = threading.Event()
 
         # The tracker must be live BEFORE the server accepts its first
@@ -922,9 +977,12 @@ class Runtime:
         # head-side aggregate).
         self._metrics_interval = config.get(
             "RAY_TPU_METRICS_INTERVAL_S")
+        self._metrics_thread = None
         if self._metrics_interval > 0:
-            threading.Thread(target=self._metrics_push_loop, daemon=True,
-                             name="metrics-push").start()
+            self._metrics_thread = threading.Thread(
+                target=self._metrics_push_loop, daemon=True,
+                name="metrics-push")
+            self._metrics_thread.start()
         # Workers call start_task_loop() AFTER worker_state is set —
         # executing a task before that races user code that touches the
         # ray_tpu API from inside tasks (dispatched specs just queue).
@@ -1689,15 +1747,16 @@ class Runtime:
             if self._lease_sweeper_started:
                 return
             self._lease_sweeper_started = True
-        t = threading.Thread(target=self._lease_sweep_loop, daemon=True,
-                             name="lease-sweeper")
-        t.start()
+        self._lease_sweeper_thread = threading.Thread(
+            target=self._lease_sweep_loop, daemon=True,
+            name="lease-sweeper")
+        self._lease_sweeper_thread.start()
 
     def _lease_sweep_loop(self):
         """Return leases idle past the linger window so workers flow back
         to the shared pool (reference: lease timeouts)."""
-        while not self._shutdown_event.is_set():
-            time.sleep(min(0.5, self._lease_linger_s / 2))
+        while not self._shutdown_event.wait(
+                min(0.5, self._lease_linger_s / 2)):
             now = time.monotonic()
             to_return = []
             to_cancel = []
@@ -1898,7 +1957,7 @@ class Runtime:
             except protocol.ConnectionClosed:
                 return
             except Exception:
-                pass
+                logger.warning("metrics push failed", exc_info=True)
 
     def get_profile_events(self) -> list:
         self.profiler.flush()
@@ -2725,12 +2784,32 @@ class Runtime:
 
     # ==================================================================
     def start_task_loop(self):
-        threading.Thread(target=self._task_loop, daemon=True,
-                         name="task-exec").start()
+        self._task_thread = threading.Thread(
+            target=self._task_loop, daemon=True, name="task-exec")
+        self._task_thread.start()
 
     def run_worker_loop(self):
         """Block until shutdown (worker main)."""
         self._shutdown_event.wait()
+
+    def _join_service_threads(self, timeout: float = 2.0):
+        """Join every long-lived loop this runtime started (each exits
+        promptly once _shutdown_event is set / its stop ran): repeated
+        init()/shutdown() in one process must not accumulate threads."""
+        deadline = time.monotonic() + timeout
+
+        def left() -> float:
+            return max(0.1, deadline - time.monotonic())
+
+        me = threading.current_thread()
+        if self._metrics_thread is not None \
+                and self._metrics_thread is not me:
+            self._metrics_thread.join(timeout=left())
+        if self._lease_sweeper_thread is not None \
+                and self._lease_sweeper_thread is not me:
+            self._lease_sweeper_thread.join(timeout=left())
+        if self._task_thread is not None and self._task_thread is not me:
+            self._task_thread.join(timeout=left())
 
     def shutdown(self):
         self._shutdown_event.set()
@@ -2743,7 +2822,22 @@ class Runtime:
             self.profiler.stop()
             self.task_events.stop()
         except Exception:
-            pass
+            logger.warning("profiler/task-event flush at shutdown "
+                           "failed", exc_info=True)
+        # Drain the conflating sender and the borrow-notify queue while
+        # peers are still reachable, then stop their threads.
+        try:
+            self._batcher.stop()
+            self.ref_tracker.stop()
+        except Exception:
+            logger.warning("data-plane drain at shutdown failed",
+                           exc_info=True)
+        actor = self._actor
+        if actor is not None:
+            try:
+                actor.stop()
+            except Exception:
+                logger.warning("actor loop stop failed", exc_info=True)
         try:
             self.head.close()
         except Exception:
@@ -2763,5 +2857,6 @@ class Runtime:
         # re-acquires _conns_lock.
         for c in conns:
             c.close()
+        self._join_service_threads()
 
 

@@ -21,11 +21,14 @@ leaking through one shared /dev/shm namespace.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import subprocess
 import sys
 import time
 from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 from ._private import node as _node
 from ._private import worker_state as _ws
@@ -122,5 +125,6 @@ class Cluster:
             try:
                 self.remove_node(h)
             except Exception:
-                pass
+                logger.warning("removing node %r at cluster shutdown "
+                               "failed", h, exc_info=True)
         _node.shutdown()

@@ -416,9 +416,30 @@ def cmd_timeline(args):
              if dropped else ""))
 
 
+def cmd_check(args):
+    """Framework-aware static analysis (graftcheck): lint rules for
+    distributed anti-patterns + static lock-order cycle detection.
+    Exits non-zero on findings not covered by the suppression
+    baseline. See README "Correctness tooling"."""
+    from ray_tpu._private.graftcheck import cli as graftcheck_cli
+    sys.exit(graftcheck_cli.run(
+        args.paths, baseline_path=args.baseline,
+        write_baseline=args.write_baseline, as_json=args.json,
+        lockgraph=not args.no_lockgraph))
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="ray_tpu.scripts")
     sub = parser.add_subparsers(dest="cmd", required=True)
+
+    p = sub.add_parser(
+        "check", help="static analysis: lint + lock-order checks")
+    p.add_argument("paths", nargs="*", default=["ray_tpu"])
+    p.add_argument("--baseline", default=None)
+    p.add_argument("--write-baseline", action="store_true")
+    p.add_argument("--json", action="store_true")
+    p.add_argument("--no-lockgraph", action="store_true")
+    p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser("start", help="start a head or join as a node")
     p.add_argument("--head", action="store_true")

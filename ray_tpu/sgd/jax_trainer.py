@@ -448,7 +448,11 @@ class JaxTrainer:
                 ray_tpu.get(r.ping.remote(), timeout=10)
                 alive.append(r)
             except Exception:
-                pass
+                # Dead runners are expected here — this probe decides
+                # which survived — but note each exclusion for the
+                # post-mortem.
+                logger.info("runner %r unresponsive; excluding from "
+                            "recovery", r)
         if not alive:
             raise RuntimeError("all runners died")
         state = ray_tpu.get(alive[0].get_state.remote())

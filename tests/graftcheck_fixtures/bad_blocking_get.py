@@ -1,0 +1,21 @@
+"""GC101: blocking get/wait inside remote code."""
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def inner(x):
+    return x
+
+
+@ray_tpu.remote
+def bad_task(x):
+    # GC101: a task blocking on another task ties up a worker slot.
+    return ray_tpu.get(inner.remote(x))
+
+
+@ray_tpu.remote
+class BadActor:
+    def work(self, ref):
+        ready, _ = ray_tpu.wait([ref])  # GC101 in an actor method
+        return ready

@@ -1,0 +1,25 @@
+"""GC106: daemon service threads with no join path."""
+
+import threading
+
+
+class Service:
+    def __init__(self):
+        self._stop = threading.Event()
+        # GC106: fire-and-forget service thread.
+        threading.Thread(target=self._push_loop, daemon=True).start()
+        # GC106: stored but never joined anywhere in the module.
+        self._drain_thread = threading.Thread(
+            target=self._drain_loop, daemon=True)
+        self._drain_thread.start()
+
+    def _push_loop(self):
+        while not self._stop.wait(1.0):
+            pass
+
+    def _drain_loop(self):
+        while not self._stop.wait(1.0):
+            pass
+
+    def stop(self):
+        self._stop.set()  # threads are signalled but never joined

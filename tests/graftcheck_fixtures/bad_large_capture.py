@@ -1,0 +1,19 @@
+"""GC102: large literals shipped through remote calls."""
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def consume(table):
+    return len(table)
+
+
+@ray_tpu.remote
+def embeds_literal():
+    lookup = [0] * 5000  # GC102: re-pickled with every export
+    return sum(lookup)
+
+
+def submit():
+    # GC102: ten-thousand-element literal pickled per submission.
+    return consume.remote([1] * 10000)

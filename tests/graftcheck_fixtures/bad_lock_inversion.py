@@ -1,0 +1,27 @@
+"""Seeded lock-order inversion for the static cycle detector (GC201).
+
+`forward()` takes A then (via a helper call) B; `backward()` takes B
+then A lexically — the A->B and B->A edges close a cycle.
+"""
+
+import threading
+
+
+class Inverted:
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.n = 0
+
+    def forward(self):
+        with self._lock_a:
+            self._grab_b()
+
+    def _grab_b(self):
+        with self._lock_b:
+            self.n += 1
+
+    def backward(self):
+        with self._lock_b:
+            with self._lock_a:
+                self.n -= 1

@@ -1,0 +1,12 @@
+"""GC103: remote function called directly."""
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def task(x):
+    return x + 1
+
+
+def runner():
+    return task(3)  # GC103: raises TypeError at runtime

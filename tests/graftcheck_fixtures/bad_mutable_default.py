@@ -1,0 +1,20 @@
+"""GC104: mutable defaults on remote signatures."""
+
+import ray_tpu
+
+
+@ray_tpu.remote
+def bad_fn(items=[]):  # GC104
+    items.append(1)
+    return items
+
+
+@ray_tpu.remote
+class BadDefaults:
+    def __init__(self, table={}):  # GC104
+        self.table = table
+
+    def merge(self, extra=None, seen=set()):  # GC104
+        if extra:
+            seen.update(extra)
+        return seen

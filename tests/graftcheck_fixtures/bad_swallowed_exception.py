@@ -1,0 +1,19 @@
+"""GC105: swallowed exceptions in service loops + bare except."""
+
+import time
+
+
+def service_loop(poll):
+    while True:
+        try:
+            poll()
+        except Exception:
+            pass  # GC105: the loop wedges silently on repeated failure
+        time.sleep(1)
+
+
+def legacy_parse(data):
+    try:
+        return int(data)
+    except:  # noqa: E722 — GC105: bare except
+        return 0

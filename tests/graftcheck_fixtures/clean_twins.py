@@ -1,0 +1,60 @@
+"""Clean twins: the same shapes written correctly — zero findings."""
+
+import logging
+import threading
+
+import ray_tpu
+
+logger = logging.getLogger(__name__)
+
+
+@ray_tpu.remote
+def clean_task(x):
+    return x + 1
+
+
+def caller():
+    ref = clean_task.remote(3)
+    return ray_tpu.get(ref)  # blocking get at the CALLER is fine
+
+
+@ray_tpu.remote
+def clean_defaults(items=None):
+    return list(items or ())
+
+
+def ship(big_table):
+    ref = ray_tpu.put(big_table)  # put once, pass the ref
+    return clean_task.remote(ref)
+
+
+def service_loop(poll):
+    while True:
+        try:
+            poll()
+        except Exception:
+            logger.warning("poll failed", exc_info=True)  # logged
+
+
+def cleanup_loop(conns):
+    for c in conns:
+        try:
+            c.close()  # best-effort cleanup call: exempt
+        except Exception:
+            pass
+
+
+class CleanService:
+    def __init__(self):
+        self._stop = threading.Event()
+        self._push_thread = threading.Thread(
+            target=self._push_loop, daemon=True)
+        self._push_thread.start()
+
+    def _push_loop(self):
+        while not self._stop.wait(1.0):
+            pass
+
+    def stop(self):
+        self._stop.set()
+        self._push_thread.join(timeout=1.0)

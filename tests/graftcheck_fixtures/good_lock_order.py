@@ -1,0 +1,23 @@
+"""Consistent lock order: nesting and helper calls, no cycle."""
+
+import threading
+
+
+class Ordered:
+    def __init__(self):
+        self._outer = threading.Lock()
+        self._inner = threading.Lock()
+        self.n = 0
+
+    def nested(self):
+        with self._outer:
+            with self._inner:
+                self.n += 1
+
+    def via_helper(self):
+        with self._outer:
+            self._take_inner()
+
+    def _take_inner(self):
+        with self._inner:
+            self.n -= 1
