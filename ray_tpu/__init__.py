@@ -38,13 +38,15 @@ from ._private.object_ref import ObjectRef
 from ._private.ids import ActorID, JobID, ObjectID, TaskID
 from .actor import ActorClass, ActorHandle, exit_actor, get_actor, method
 from .remote_function import RemoteFunction
-from .exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
+from .exceptions import (ActorDiedError, ActorUnavailableError,
+                         GetTimeoutError, ObjectLostError,
                          RayActorError, RayError, RayTaskError, TaskError,
                          WorkerCrashedError)
 
 __version__ = "0.1.0"
 
 _LOCAL_RUNTIME = None
+_CHAOS_ENV_SET = False
 
 
 def init(num_cpus: _Optional[float] = None,
@@ -53,20 +55,32 @@ def init(num_cpus: _Optional[float] = None,
          local_mode: bool = False,
          num_initial_workers: int = 0,
          worker_env: _Optional[dict] = None,
-         address: _Optional[str] = None):
+         address: _Optional[str] = None,
+         chaos: _Optional[str] = None):
     """Start the runtime (parity: `ray.init`, `python/ray/worker.py:525`).
 
     With `address="tcp://host:port"` the driver attaches to an existing
     head started by `python -m ray_tpu.scripts start --head` (parity:
     `ray.init(redis_address=...)`); shutdown then only detaches.
     In a worker process this is a no-op (the worker is already connected).
+
+    `chaos` arms the deterministic fault-injection plane for the whole
+    session (equivalent to exporting ``RAY_TPU_CHAOS=<spec>`` before
+    start; spawned workers and node agents inherit the schedule). See
+    README "Fault tolerance & chaos testing" for the spec grammar.
     """
-    global _LOCAL_RUNTIME
+    global _LOCAL_RUNTIME, _CHAOS_ENV_SET
     if _ws.mode() == _ws.WORKER_MODE:
         return None
     if _ws.get_runtime_or_none() is not None:
         raise RuntimeError("ray_tpu.init() called twice; call "
                            "ray_tpu.shutdown() first")
+    if chaos:
+        from ._private import chaos as _chaos
+        from ._private import config as _config
+        _chaos.parse_spec(chaos)  # fail fast on a bad spec
+        _config.set_override("RAY_TPU_CHAOS", chaos)
+        _CHAOS_ENV_SET = True
     if address is None:
         # `ray_tpu.scripts exec` injects the cluster address (parity:
         # `ray exec` / RAY_ADDRESS).
@@ -84,7 +98,14 @@ def init(num_cpus: _Optional[float] = None,
 
 def shutdown():
     """Stop the runtime and clean up the session (parity: `ray.shutdown`)."""
-    global _LOCAL_RUNTIME
+    global _LOCAL_RUNTIME, _CHAOS_ENV_SET
+    if _CHAOS_ENV_SET:
+        # A schedule armed via init(chaos=...) dies with the session.
+        from ._private import chaos as _chaos
+        from ._private import config as _config
+        _config.clear_override("RAY_TPU_CHAOS")
+        _CHAOS_ENV_SET = False
+        _chaos.uninstall()
     if _LOCAL_RUNTIME is not None:
         _LOCAL_RUNTIME.shutdown()
         _LOCAL_RUNTIME = None
@@ -265,7 +286,8 @@ def cluster_metrics() -> dict:
 
 
 __all__ = [
-    "ActorClass", "ActorDiedError", "ActorHandle", "GetTimeoutError",
+    "ActorClass", "ActorDiedError", "ActorHandle",
+    "ActorUnavailableError", "GetTimeoutError",
     "ObjectLostError", "ObjectRef", "RayActorError", "RayError",
     "RayTaskError", "TaskError", "WorkerCrashedError", "available_resources",
     "cluster_info", "cluster_metrics", "cluster_resources", "exceptions",

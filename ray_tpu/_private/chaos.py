@@ -1,0 +1,337 @@
+"""Chaos plane: seeded, deterministic fault injection at layer seams.
+
+The fault-tolerance machinery (owner-side retries, lineage-lite
+reconstruction, actor restarts, stream-death redispatch) is exercised in
+normal tests by one hand-crafted fault at a time. Real preemptions and
+link faults arrive concurrently and MID-PROTOCOL — in the windows
+between lifecycle states (result computed but its push dropped; a
+stripe stream dying with half an object landed; an actor restarting
+while a call is in flight). This module arms cheap hooks at those
+seams so a schedule of faults can hit the windows reproducibly.
+
+Design (parity: the reference's `RAY_testing_asio_delay_us`-style
+injection knobs and its chaos-testing suite `test_chaos.py` /
+`chaos_test` scripts, generalized):
+
+- Every process parses the SAME spec (``RAY_TPU_CHAOS`` env, inherited
+  by spawned workers/agents; or ``ray_tpu.init(chaos=...)``) into a
+  :class:`ChaosController`.
+- Injection sites call ``chaos.controller`` — a module global that is
+  ``None`` when chaos is off, so a disabled hook costs one global read
+  and an ``is not None`` branch (nothing measurable on the hot paths).
+- Each armed rule draws from its OWN ``random.Random`` seeded from
+  ``(seed, site, kind, trigger)``: rule draws are independent of thread
+  interleaving across sites, so a run's injection trace replays
+  exactly from its seed (see :func:`replay`).
+- Every injection appends to an in-process trace (and, when
+  ``RAY_TPU_CHAOS_TRACE`` names a file, a JSONL line) and bumps
+  ``chaos_injections_total`` plus a per-site/kind counter in the
+  metrics plane. Execution-site injections additionally annotate the
+  task's lifecycle record via ``task_events.ANNOTATE``.
+
+Spec grammar (semicolon-separated clauses)::
+
+    seed=<int>;<site>:<kind>:<trigger>[:<param>];...
+
+    trigger :=  n<k>      fire on the k-th occurrence in this process
+              | every<k>  fire on every k-th occurrence
+              | p<float>  fire with probability per occurrence (seeded)
+              | once<k>   like n<k>, but at most once per SESSION
+                          (claimed atomically via a marker file — a
+                          respawned worker must not re-kill itself on
+                          its own k-th occurrence forever)
+    param   :=  free-form per kind (e.g. delay seconds; default 0.05)
+
+Example::
+
+    RAY_TPU_CHAOS="seed=7;wire.send:drop:p0.01;exec.before:kill:once2"
+
+Site catalog (site -> fault kinds): see :data:`SITES`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Dict, List, Optional
+
+logger = None  # set lazily; this module must import nothing heavy
+
+# Injection-site catalog: every site an armed hook can fire at, with the
+# fault kinds it understands. `scripts chaos --catalog` prints this.
+SITES: Dict[str, Dict[str, str]] = {
+    "wire.send": {
+        "drop": "silently discard the outgoing protocol message",
+        "delay": "sleep <param> seconds before the send (default 0.05)",
+        "dup": "send the frame twice (duplicated delivery)",
+        "truncate": "ship half the frame, then close the connection",
+        "close": "close the connection instead of sending",
+    },
+    "wire.recv": {
+        "drop": "discard the inbound message before dispatch",
+        "delay": "sleep <param> seconds before dispatch (default 0.05)",
+    },
+    "stripe.send": {
+        "abort": "kill the transfer stream mid-stripe (chunk send fails)",
+    },
+    "exec.before": {
+        "kill": "kill the worker process before the task body runs",
+    },
+    "exec.after": {
+        "kill": "kill the worker after the task body ran, before the "
+                "result push (the lost-update window)",
+        "drop_result": "complete the task but never push its result",
+    },
+    "agent.heartbeat": {
+        "suppress": "node agent skips sending its heartbeat",
+    },
+    "head.heartbeat": {
+        "drop": "head ignores an arriving heartbeat (one-way partition)",
+    },
+    "store.read": {
+        "evict": "evict the object from the local store at read time",
+        "corrupt": "flip a byte of the stored blob (bad checksum)",
+    },
+}
+
+
+class ChaosSpecError(ValueError):
+    pass
+
+
+class _Rule:
+    __slots__ = ("site", "kind", "trigger", "value", "param", "spec",
+                 "_rng", "_once_name")
+
+    def __init__(self, site: str, kind: str, trigger: str, value: float,
+                 param: Optional[str], seed: int, spec: str):
+        self.site = site
+        self.kind = kind
+        self.trigger = trigger  # 'n' | 'every' | 'p' | 'once'
+        self.value = value
+        self.param = param
+        self.spec = spec
+        import random
+        self._rng = random.Random(
+            f"{seed}|{site}|{kind}|{trigger}|{value}")
+        self._once_name = f"chaos_once_{site}_{kind}_{trigger}{value}" \
+            .replace(".", "_").replace(":", "_")
+
+    def matches(self, occ: int) -> bool:
+        """Pure (side-effect-free except the rule's own rng stream):
+        would this rule fire on occurrence `occ`?"""
+        if self.trigger == "n" or self.trigger == "once":
+            return occ == int(self.value)
+        if self.trigger == "every":
+            return int(self.value) > 0 and occ % int(self.value) == 0
+        # 'p': one draw per occurrence keeps the stream deterministic.
+        return self._rng.random() < self.value
+
+    def claim_once(self, once_dir: Optional[str]) -> bool:
+        """Session-wide at-most-once claim via an O_EXCL marker file.
+        With no once_dir the rule degrades to per-process n<k>."""
+        if self.trigger != "once" or not once_dir:
+            return True
+        path = os.path.join(once_dir, self._once_name)
+        try:
+            fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_WRONLY)
+            os.close(fd)
+            return True
+        except FileExistsError:
+            return False
+        except OSError:
+            return True  # unwritable dir: prefer injecting over skipping
+
+    @property
+    def delay(self) -> float:
+        try:
+            return float(self.param) if self.param else 0.05
+        except ValueError:
+            return 0.05
+
+
+def parse_spec(spec: str, once_dir: Optional[str] = None):
+    """Returns (seed, [rules]). Raises ChaosSpecError on a bad spec."""
+    seed = 0
+    rules: List[_Rule] = []
+    clauses = [c.strip() for c in spec.split(";") if c.strip()]
+    raw_rules = []
+    for clause in clauses:
+        if clause.startswith("seed="):
+            try:
+                seed = int(clause[5:])
+            except ValueError:
+                raise ChaosSpecError(f"bad seed clause {clause!r}")
+            continue
+        parts = clause.split(":")
+        if len(parts) not in (3, 4):
+            raise ChaosSpecError(
+                f"bad chaos clause {clause!r}: want "
+                f"site:kind:trigger[:param]")
+        raw_rules.append(parts)
+    for parts in raw_rules:
+        site, kind, trig = parts[0], parts[1], parts[2]
+        param = parts[3] if len(parts) == 4 else None
+        if site not in SITES:
+            raise ChaosSpecError(
+                f"unknown chaos site {site!r}; known: {sorted(SITES)}")
+        if kind not in SITES[site]:
+            raise ChaosSpecError(
+                f"unknown fault kind {kind!r} for site {site!r}; "
+                f"known: {sorted(SITES[site])}")
+        for name in ("once", "every", "n", "p"):
+            if trig.startswith(name):
+                try:
+                    value = float(trig[len(name):])
+                except ValueError:
+                    raise ChaosSpecError(f"bad trigger {trig!r}")
+                break
+        else:
+            raise ChaosSpecError(
+                f"bad trigger {trig!r}: want n<k>, every<k>, p<float> "
+                f"or once<k>")
+        if name == "p" and not 0.0 <= value <= 1.0:
+            raise ChaosSpecError(f"probability out of range in {trig!r}")
+        rules.append(_Rule(site, kind, name, value, param, seed,
+                           ":".join(parts)))
+    return seed, rules
+
+
+class ChaosController:
+    """Per-process injection engine: counts site occurrences, fires the
+    schedule's rules against them, records the trace."""
+
+    def __init__(self, spec: str, trace_path: Optional[str] = None,
+                 once_dir: Optional[str] = None):
+        self.spec = spec
+        self.seed, rules = parse_spec(spec)
+        self.trace_path = trace_path
+        self.once_dir = once_dir
+        self._by_site: Dict[str, List[_Rule]] = {}
+        for r in rules:
+            self._by_site.setdefault(r.site, []).append(r)
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._seq = 0
+        self.trace: List[dict] = []
+
+    def fire(self, site: str, detail: str = "") -> Optional[_Rule]:
+        """Count one occurrence at `site`; return the rule to apply (or
+        None). Call sites guard with `chaos.controller is not None`, so
+        this only runs when chaos is armed."""
+        rules = self._by_site.get(site)
+        if not rules:
+            return None
+        with self._lock:
+            occ = self._counts.get(site, 0) + 1
+            self._counts[site] = occ
+            fired = None
+            for rule in rules:
+                if rule.matches(occ):
+                    fired = rule
+                    break
+            if fired is None:
+                return None
+            if not fired.claim_once(self.once_dir):
+                return None
+            self._seq += 1
+            entry = {"pid": os.getpid(), "seq": self._seq, "site": site,
+                     "kind": fired.kind, "occ": occ, "rule": fired.spec,
+                     "detail": str(detail)[:120]}
+            self.trace.append(entry)
+        self._record(entry)
+        return fired
+
+    def _record(self, entry: dict) -> None:
+        try:
+            from . import metrics
+            metrics.inc("chaos_injections_total")
+            metrics.inc("chaos_injected.%s.%s"
+                        % (entry["site"], entry["kind"]))
+        except Exception:
+            pass
+        if self.trace_path:
+            try:
+                with open(self.trace_path, "a") as f:
+                    f.write(json.dumps(entry, sort_keys=True) + "\n")
+            except OSError:
+                pass
+
+    def occurrences(self, site: str) -> int:
+        with self._lock:
+            return self._counts.get(site, 0)
+
+
+def trace_bytes(entries: List[dict]) -> bytes:
+    """Canonical serialization for byte-identical replay comparison."""
+    return "\n".join(
+        json.dumps(e, sort_keys=True) for e in entries).encode()
+
+
+def replay(spec: str, entries: List[dict]) -> List[dict]:
+    """Re-derive the injections a fresh controller (same spec ⇒ same
+    seed ⇒ same per-rule rng streams) produces for the recorded
+    occurrence history, per process. Feeding each pid's per-site
+    occurrence indices back through a new controller must reproduce the
+    trace byte-for-byte (`trace_bytes(entries) == trace_bytes(replay())`
+    — the determinism gate chaos runs assert in CI)."""
+    out: List[dict] = []
+    by_pid: Dict[int, List[dict]] = {}
+    for e in entries:
+        by_pid.setdefault(e["pid"], []).append(e)
+    for pid, pid_entries in by_pid.items():
+        ctl = ChaosController(spec)  # no once_dir: replay ignores claims
+        for e in sorted(pid_entries, key=lambda x: x["seq"]):
+            # Advance the site counter through the silent occurrences.
+            while ctl.occurrences(e["site"]) < e["occ"] - 1:
+                ctl.fire(e["site"])
+            fired = ctl.fire(e["site"], e["detail"])
+            if fired is None:
+                # Divergence: surface it as a trace mismatch.
+                continue
+        for r in ctl.trace:
+            r = dict(r)
+            r["pid"] = pid
+            out.append(r)
+    out.sort(key=lambda e: (e["pid"], e["seq"]))
+    return out
+
+
+def load_trace(path: str) -> List[dict]:
+    entries = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                entries.append(json.loads(line))
+    entries.sort(key=lambda e: (e["pid"], e["seq"]))
+    return entries
+
+
+# ---------------------------------------------------------------------
+# module-global controller: the one symbol hot paths read
+# ---------------------------------------------------------------------
+controller: Optional[ChaosController] = None
+
+
+def install_from_env() -> Optional[ChaosController]:
+    """Arm (or disarm) this process's controller from RAY_TPU_CHAOS.
+    Called at every daemon/runtime bring-up so spawned workers inherit
+    the schedule through their environment."""
+    global controller
+    from . import config
+    spec = config.get("RAY_TPU_CHAOS")
+    if not spec:
+        controller = None
+        return None
+    controller = ChaosController(
+        spec,
+        trace_path=config.get("RAY_TPU_CHAOS_TRACE") or None,
+        once_dir=os.environ.get("RAY_TPU_SESSION_DIR") or None)
+    return controller
+
+
+def uninstall() -> None:
+    global controller
+    controller = None

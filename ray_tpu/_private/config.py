@@ -111,6 +111,22 @@ _def("RAY_TPU_TASK_LOG_MAX", int, 4096,
 _def("RAY_TPU_NUM_ACTOR_CHECKPOINTS_TO_KEEP", int, 20,
      "Checkpoint ids retained per Checkpointable actor")
 
+# --- chaos plane (fault injection; _private/chaos.py) -----------------
+_def("RAY_TPU_CHAOS", str, None,
+     "Deterministic fault-injection schedule, armed in every process "
+     "that sees it (spec grammar: seed=<int>;site:kind:trigger[:param];"
+     "... — see README 'Fault tolerance & chaos testing'). Empty/unset "
+     "disables chaos; disabled hooks cost one global read")
+_def("RAY_TPU_CHAOS_TRACE", str, None,
+     "JSONL file every chaos injection is appended to (pid/seq/site/"
+     "kind/occurrence); pretty-print or replay-verify it with "
+     "`ray_tpu.scripts chaos`")
+_def("RAY_TPU_LEASED_PROBE_S", float, 10.0,
+     "Age after which an unfinished leased task's worker is probed for "
+     "liveness of that exact task; a worker that no longer knows the "
+     "task (dropped dispatch, or result push lost in flight) triggers "
+     "a head-path resubmit instead of an indefinite hang")
+
 # --- correctness tooling (graftcheck) ---------------------------------
 _def("RAY_TPU_LOCKCHECK", bool, False,
      "Wrap runtime locks in order-tracing shims (graftcheck runtime "
@@ -160,6 +176,22 @@ def get(name: str):
     except (TypeError, ValueError):
         raise ValueError(
             f"{name}={raw!r} is not a valid {d.type.__name__}")
+
+
+def set_override(name: str, value) -> None:
+    """Programmatic env override for a REGISTERED tunable (e.g.
+    `ray_tpu.init(chaos=...)` arming RAY_TPU_CHAOS for the session's
+    spawned processes). Keeps raw os.environ writes of tunables out of
+    the rest of the tree — the registry stays the single chokepoint."""
+    if name not in _DEFS:
+        raise KeyError(
+            f"{name} is not a registered tunable; declare it in "
+            f"_private/config.py")
+    os.environ[name] = str(value)
+
+
+def clear_override(name: str) -> None:
+    os.environ.pop(name, None)
 
 
 def defs() -> Dict[str, ConfigDef]:

@@ -19,6 +19,10 @@ Rule catalog (ids are stable; see README "Correctness tooling"):
 - GC106 unjoined-service-thread: a daemon thread running a ``*_loop``
   service target must be stored and joined on some shutdown path, or
   repeated init/shutdown leaks threads between tests.
+- GC107 unbounded-retry-loop: a ``while True`` loop whose exception
+  handler retries (``continue``) with no bound or backoff anywhere in
+  the loop hot-spins forever against a persistent failure; route it
+  through ``_private/backoff.Backoff`` (or any sleep/wait/timeout).
 """
 
 from __future__ import annotations
@@ -241,6 +245,80 @@ class SwallowedExceptionInLoop(Rule):
                         "exception ('except Exception: pass'): "
                         "failures become silent wedges; log the "
                         "error or narrow the except")
+
+
+@register
+class UnboundedRetryLoop(Rule):
+    id = "GC107"
+    severity = SEVERITY_WARNING
+    doc = ("retry loop ('while True' + except->continue) with no "
+           "bound or backoff")
+
+    # Call names that count as pacing/bounding the loop: an explicit
+    # sleep, any blocking wait (wait/wait_for/...), or the shared
+    # Backoff schedule.
+    _PACED_NAMES = frozenset({"sleep", "backoff", "Backoff"})
+
+    @staticmethod
+    def _is_true(test: ast.expr) -> bool:
+        return isinstance(test, ast.Constant) and bool(test.value) is True
+
+    def _call_name(self, node: ast.Call) -> str:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            return f.attr
+        if isinstance(f, ast.Name):
+            return f.id
+        return ""
+
+    def _call_paces(self, node: ast.Call) -> bool:
+        name = self._call_name(node)
+        if name in self._PACED_NAMES or name.startswith("wait"):
+            return True
+        # Calls on a backoff object (`b.sleep()` already matches; this
+        # catches `self._backoff.next_delay()` shapes too).
+        f = node.func
+        if isinstance(f, ast.Attribute) \
+                and isinstance(f.value, ast.Attribute) \
+                and "backoff" in f.value.attr.lower():
+            return True
+        # A blocking call bounded by `timeout=` (queue.get/put,
+        # request, join, ...) paces the loop the same way a sleep does.
+        return any(kw.arg == "timeout" for kw in node.keywords)
+
+    def _loop_is_paced(self, loop: ast.While) -> bool:
+        for node in ast.walk(loop):
+            if isinstance(node, ast.Call) and self._call_paces(node):
+                return True
+        return False
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for loop in ast.walk(ctx.tree):
+            if not (isinstance(loop, ast.While)
+                    and self._is_true(loop.test)):
+                continue
+            paced = None  # computed lazily, once per loop
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Try):
+                    continue
+                for handler in node.handlers:
+                    retries = any(isinstance(n, ast.Continue)
+                                  for n in ast.walk(handler))
+                    reraises = any(isinstance(n, ast.Raise)
+                                   for n in ast.walk(handler))
+                    if not retries or reraises:
+                        continue
+                    if paced is None:
+                        paced = self._loop_is_paced(loop)
+                    if paced:
+                        break
+                    yield ctx.finding(
+                        self, handler,
+                        "retry loop with no bound or backoff: the "
+                        "handler retries ('continue') but nothing in "
+                        "the loop sleeps, waits, or bounds attempts; "
+                        "use _private/backoff.Backoff (raise when "
+                        "sleep() returns False)")
 
 
 @register

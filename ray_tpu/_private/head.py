@@ -37,7 +37,7 @@ from typing import Dict, List, Optional, Set
 from ..exceptions import ActorDiedError, WorkerCrashedError
 from .ids import ActorID, TaskID
 from .task_spec import ACTOR_CREATION_TASK, TaskSpec
-from . import config, protocol, task_events
+from . import chaos, config, protocol, task_events
 
 logger = logging.getLogger(__name__)
 
@@ -141,6 +141,11 @@ class HeadServer:
         self.session_name = session_name
         self.sock_path = os.path.join(session_dir, "head.sock")
         self.worker_env = worker_env or {}
+        # Chaos plane: the head arms the same schedule every other
+        # process parses from RAY_TPU_CHAOS (chaos.py).
+        ctl = chaos.install_from_env()
+        if ctl is not None and not ctl.once_dir:
+            ctl.once_dir = session_dir
 
         self._lock = threading.RLock()
         self._kv: Dict[str, bytes] = {}
@@ -186,6 +191,12 @@ class HeadServer:
         # Per-process metric snapshots pushed by workers/drivers
         # (addr -> {"node":, "counters":, "gauges":}).
         self._metric_snaps: Dict[str, dict] = {}
+        # COUNTERS of processes that died or disconnected, folded per
+        # node: a counter is a cluster-lifetime total, so a killed
+        # worker's tasks_executed / chaos_injections_total must not
+        # vanish with its connection (gauges are point-in-time and DO
+        # die with the process).
+        self._dead_counters: Dict[str, Dict[str, float]] = {}
         self._metrics_http = None
 
         self.server = protocol.Server(
@@ -265,7 +276,12 @@ class HeadServer:
         with self._lock:
             self._conns_by_addr.pop(conn.peer_addr, None)
             self._drivers.discard(conn)
-            self._metric_snaps.pop(conn.peer_addr, None)
+            snap = self._metric_snaps.pop(conn.peer_addr, None)
+            if snap is not None:
+                dead = self._dead_counters.setdefault(
+                    snap.get("node") or "node0", {})
+                for k, v in (snap.get("counters") or {}).items():
+                    dead[k] = dead.get(k, 0.0) + v
             for subs in self._subs.values():
                 subs.discard(conn)
         self._release_leases_of(conn.peer_addr)
@@ -355,6 +371,13 @@ class HeadServer:
         self._publish(msg["channel"], msg["data"])
 
     def _h_heartbeat(self, conn, msg):
+        c = chaos.controller
+        if c is not None \
+                and c.fire("head.heartbeat", msg.get("node_id", "")):
+            # 'drop': one-way partition — the agent believes it is
+            # beating; the head hears silence and must walk the node
+            # through the ordinary heartbeat-timeout death path.
+            return
         with self._lock:
             node = self._nodes.get(msg["node_id"])
             if node is not None:
@@ -392,6 +415,9 @@ class HeadServer:
         from . import metrics as metrics_mod
         with self._lock:
             snaps = dict(self._metric_snaps)
+            for node, dead in self._dead_counters.items():
+                snaps[f"__dead__{node}"] = {
+                    "node": node, "counters": dict(dead), "gauges": {}}
             head_counters = {
                 "head_pending_tasks": float(len(self._pending)),
                 "head_inflight_tasks": float(len(self._inflight)),
@@ -916,6 +942,17 @@ class HeadServer:
         for ev in msg.get("events", ()):
             self._task_log.apply(ev)
 
+    def _h_task_alive(self, conn, msg):
+        """Owner-side lost-update backstop (runtime._producer_confirmed):
+        is this head-path task still queued or dispatched? 'No' while
+        the owner's ledger says in-flight means the task finished but
+        its result push was dropped — the owner then reconstructs."""
+        tid: TaskID = msg["task_id"]
+        with self._lock:
+            alive = tid in self._inflight \
+                or any(spec.task_id == tid for spec in self._pending)
+        conn.reply(msg, alive=alive)
+
     def _h_get_tasks(self, conn, msg):
         conn.reply(
             msg,
@@ -1136,6 +1173,8 @@ class HeadServer:
             for w in dead:
                 self._handle_worker_death(w)
             for node in stale_nodes:
+                from . import metrics as metrics_mod
+                metrics_mod.inc("node_heartbeat_timeouts")
                 self._publish("error", (
                     f"node {node.node_id} missed heartbeats for "
                     f"{self._heartbeat_timeout:g}s; declaring it dead"))

@@ -51,6 +51,11 @@ class NodeAgent:
         self._procs: Dict[str, subprocess.Popen] = {}  # token -> proc
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
+        # Chaos plane (chaos.py): heartbeat suppression etc.
+        from . import chaos
+        ctl = chaos.install_from_env()
+        if ctl is not None and not ctl.once_dir:
+            ctl.once_dir = session_dir
         os.makedirs(os.path.join(session_dir, "logs"), exist_ok=True)
         # SIGUSR1 -> all-thread stack dump (debug.py; the runtime's
         # TSAN/gdb-attach analog for wedged daemons).
@@ -140,6 +145,14 @@ class NodeAgent:
             now = time.monotonic()
             if now - last_hb >= hb_interval:
                 last_hb = now
+                from . import chaos
+                c = chaos.controller
+                if c is not None \
+                        and c.fire("agent.heartbeat", self.node_id):
+                    # 'suppress': the node goes silent while its TCP
+                    # connection stays open — the wedged-node shape the
+                    # head's deadline-driven liveness must catch.
+                    continue
                 try:
                     # mem_frac lets the head gate placement on this
                     # node before its OOM killer fires (NodeInfo.fits).

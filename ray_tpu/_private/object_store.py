@@ -268,6 +268,24 @@ class SharedObjectStore:
             self._pins[oid] = _Pin(mm)
         return ObjectEntry(value)
 
+    def corrupt_blob(self, oid: ObjectID) -> bool:
+        """Flip an early byte of a sealed object's file in place (the
+        chaos plane's bad-checksum fault, `store.read:corrupt`): the
+        next read must FAIL TO DECODE rather than silently surface
+        garbage, and the caller-side recovery replaces the blob."""
+        path = self._path(oid)
+        try:
+            with open(path, "r+b") as f:
+                f.seek(8)  # inside the blob header: decode must break
+                b = f.read(1)
+                if not b:
+                    return False
+                f.seek(8)
+                f.write(bytes([b[0] ^ 0xFF]))
+            return True
+        except OSError:
+            return False
+
     def delete(self, oid: ObjectID) -> None:
         with self._lock:
             self._pins.pop(oid, None)

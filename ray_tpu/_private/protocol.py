@@ -24,8 +24,10 @@ import pickle
 import socket
 import struct
 import threading
+import time
 from typing import Callable, Dict, Optional
 
+from . import chaos
 from .graftcheck.runtime_trace import make_lock
 
 logger = logging.getLogger(__name__)
@@ -210,6 +212,12 @@ class Connection:
                 hooks[1](self.peer_addr)
         else:
             payload = pickle.dumps(msg, protocol=PICKLE_PROTOCOL)
+        c = chaos.controller
+        if c is not None:
+            rule = c.fire("wire.send", msg.get("kind", ""))
+            if rule is not None and self._chaos_send_fault(
+                    rule, payload, buffer):
+                return
         try:
             with self._send_lock:
                 if buffer is not None:
@@ -219,6 +227,45 @@ class Connection:
         except (OSError, ConnectionClosed) as e:
             self._handle_close()
             raise ConnectionClosed(str(e)) from e
+
+    def _chaos_send_fault(self, rule, payload: bytes, buffer) -> bool:
+        """Apply an armed wire.send fault. Returns True when the frame
+        was consumed by the fault (caller must NOT send it)."""
+        if rule.kind == "delay":
+            time.sleep(rule.delay)
+            return False
+        if rule.kind == "drop":
+            # The caller believes the message was delivered — exactly
+            # the lost-update shape recovery has to survive.
+            return True
+        if rule.kind == "dup":
+            try:
+                with self._send_lock:
+                    if buffer is not None:
+                        _send_msg_oob(self.sock, payload, buffer)
+                    else:
+                        _send_msg(self.sock, payload)
+            except (OSError, ConnectionClosed):
+                pass
+            return False  # the normal send follows: duplicated delivery
+        if rule.kind == "truncate":
+            # Claim the full frame length, ship half the body, then
+            # close: the peer's recv loop desyncs mid-frame and must
+            # treat the connection as dead, never surface a partial
+            # message.
+            try:
+                with self._send_lock:
+                    if buffer is None and len(payload) > 1:
+                        self.sock.sendall(
+                            _LEN.pack(len(payload))
+                            + payload[:len(payload) // 2])
+            except OSError:
+                pass
+            self._handle_close()
+            raise ConnectionClosed("chaos: frame truncated mid-send")
+        # 'close'
+        self._handle_close()
+        raise ConnectionClosed("chaos: connection closed by schedule")
 
     def request(self, msg: dict, timeout: Optional[float] = None):
         """Send a message and block for its reply; returns the reply dict."""
@@ -251,6 +298,16 @@ class Connection:
                 payload = _recv_msg(self.sock)
                 msg = payload if isinstance(payload, dict) \
                     else pickle.loads(payload)
+                c = chaos.controller
+                if c is not None and msg.get("kind") != "reply":
+                    # Replies are exempt: dropping them only converts a
+                    # blocked request() into its rpc timeout, which the
+                    # wire.send faults already cover from the other end.
+                    rule = c.fire("wire.recv", msg.get("kind", ""))
+                    if rule is not None:
+                        if rule.kind == "drop":
+                            continue
+                        time.sleep(rule.delay)  # 'delay'
                 if msg.get("kind") == "reply":
                     fut = self._pending.get(msg["reply_to"])
                     if fut is not None:

@@ -35,9 +35,10 @@ import cloudpickle
 
 from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
                           TaskError, WorkerCrashedError)
-from . import config
+from . import chaos, config
 from . import object_ref as object_ref_mod
 from . import protocol, serialization, task_events
+from .backoff import Backoff
 from .graftcheck.runtime_trace import (make_condition, make_lock,
                                        make_rlock)
 from .ids import ActorID, JobID, ObjectID, TaskID
@@ -267,6 +268,13 @@ class _TransferPool:
     # -- sending -------------------------------------------------------
     def _send_item(self, conn, item):
         ticket, idx, offset, chunk = item
+        c = chaos.controller
+        if c is not None:
+            rule = c.fire("stripe.send",
+                          f"{ticket.oid.hex()[:12]}#{idx}")
+            if rule is not None:  # 'abort': stream dies mid-stripe
+                raise protocol.ConnectionClosed(
+                    "chaos: transfer stream aborted mid-stripe")
         codec, payload = ticket.encoder.encode(chunk)
         t0 = time.monotonic()
         # Payload rides the frame out-of-band (protocol._send_msg_oob):
@@ -526,13 +534,14 @@ class _RefTracker:
         import queue as _queue
         # Borrow notifications gate owner-side eviction: a dropped
         # add_borrow means the owner may evict an object we hold, so
-        # failed deliveries retry with backoff (r3 advisor finding).
-        # Delivery is strictly FIFO PER OWNER (an ack_export must never
-        # overtake its add_borrow), and retries are deferred, not slept
-        # inline: one unreachable owner freezes only its own queue, not
-        # every owner sharing this thread.
+        # failed deliveries retry on the shared jittered backoff
+        # schedule (backoff.py; r3 advisor finding). Delivery is
+        # strictly FIFO PER OWNER (an ack_export must never overtake
+        # its add_borrow), and retries are deferred, not slept inline:
+        # one unreachable owner freezes only its own queue, not every
+        # owner sharing this thread.
         pending: Dict[str, deque] = {}   # owner -> undelivered, in order
-        retry_at: Dict[str, tuple] = {}  # owner -> (due, attempt)
+        retry_at: Dict[str, list] = {}   # owner -> [due, Backoff]
 
         def drain(owner: str):
             q = pending.get(owner)
@@ -542,8 +551,11 @@ class _RefTracker:
                     self._rt._get_conn(owner).send(
                         {"kind": kind, "object_id": oid})
                 except Exception as e:
-                    _, attempt = retry_at.get(owner, (0, 0))
-                    if attempt >= 5:
+                    entry = retry_at.get(owner)
+                    b = entry[1] if entry is not None else Backoff(
+                        base=0.05, factor=2.0, cap=2.0, max_attempts=5)
+                    delay = b.next_delay()
+                    if delay is None:
                         # Unreachable through the whole backoff window:
                         # likely dead. Drop this owner's ENTIRE queue —
                         # delivering a later message after dropping an
@@ -557,9 +569,7 @@ class _RefTracker:
                             owner, len(q), kind, oid, e)
                         q.clear()
                         break
-                    retry_at[owner] = (
-                        time.monotonic() + 0.05 * (2 ** attempt),
-                        attempt + 1)
+                    retry_at[owner] = [time.monotonic() + delay, b]
                     return
                 q.popleft()
                 retry_at.pop(owner, None)
@@ -766,6 +776,13 @@ class Runtime:
         # on the driver's error stream.
         from .debug import install_thread_excepthook
         install_thread_excepthook()
+        # Chaos plane: arm this process's fault-injection controller
+        # from RAY_TPU_CHAOS (workers/agents inherit the schedule via
+        # their environment). Off (the default) leaves the module
+        # global None, which is all a disabled hook ever reads.
+        ctl = chaos.install_from_env()
+        if ctl is not None and not ctl.once_dir:
+            ctl.once_dir = session_dir  # session-wide once<k> claims
         self.node_id = node_id or os.environ.get("RAY_TPU_NODE_ID", "node0")
         # In a multi-node session (head reached over TCP) every process
         # serves on TCP so peers on other nodes can dial it; single-node
@@ -914,6 +931,10 @@ class Runtime:
         self._lease_fast_cap = max(1, config.get(
             "RAY_TPU_LEASE_FAST_TASK_MAX_LEASES"))
         self._lease_linger_s = config.get("RAY_TPU_LEASE_LINGER_S")
+        # Last task_state probe per in-flight leased task (see
+        # _probe_stale_leased: dropped dispatch / dropped result push
+        # recovery).
+        self._lease_probe_at: Dict[TaskID, float] = {}
         self._lease_sweeper_started = False
         self._lease_sweeper_thread: Optional[threading.Thread] = None
 
@@ -939,6 +960,16 @@ class Runtime:
         from .memory_monitor import MemoryMonitor
         self._memory_monitor = MemoryMonitor()
         self._task_queue: "queue.Queue[TaskSpec]" = queue.Queue()
+        # Execution-liveness ledger for the task_state probe protocol:
+        # callers whose dispatched task never completes (its execute_task
+        # or result push was lost on the wire) ask the worker whether it
+        # still knows the task. `running` = queued or executing here;
+        # `done` = completed recently (result push in flight or lost);
+        # anything else = the dispatch never arrived.
+        self._executing_tids: Set[TaskID] = set()
+        self._recent_done: deque = deque(maxlen=512)
+        self._exec_state_lock = make_lock("Runtime._exec_state_lock")
+        self._leased_probe_s = config.get("RAY_TPU_LEASED_PROBE_S")
         self._task_thread: Optional[threading.Thread] = None
         self._actor: Optional[ActorState] = None
         # Actor calls that arrived before __init__ finished.
@@ -1204,17 +1235,56 @@ class Runtime:
             raise GetTimeoutError("ray_tpu.get timed out")
         return rem
 
+    def _chaos_store_read(self, oid: ObjectID, cell: _Cell) -> None:
+        """store.read injection: evict or corrupt the object as it is
+        read, exercising the lost/corrupt recovery paths."""
+        rule = chaos.controller.fire("store.read", oid.hex()[:12])
+        if rule is None:
+            return
+        if rule.kind == "evict":
+            self.memory.delete(oid)
+            self.shm.delete(oid)
+            raise ObjectLostError(
+                f"chaos: object {oid.hex()[:16]} evicted at read")
+        if rule.kind == "corrupt":
+            # Corrupt the STORED copy: the decode below must fail the
+            # same way a checksum mismatch would.
+            if cell.kind == "raw" and len(cell.payload) > 8:
+                buf = bytearray(cell.payload)
+                buf[len(buf) // 2] ^= 0xFF
+                cell.payload = bytes(buf)
+            elif cell.kind == "shm":
+                self.shm.corrupt_blob(oid)
+
     def _decode_cell(self, oid: ObjectID, cell: _Cell):
         if cell.kind == "error":
             raise cell.payload
         if cell.kind == "value":
             return cell.payload
+        if chaos.controller is not None and cell.kind in ("raw", "shm"):
+            self._chaos_store_read(oid, cell)
         if cell.kind == "raw":
-            value = serialization.loads(cell.payload, zero_copy=False)
+            try:
+                value = serialization.loads(cell.payload, zero_copy=False)
+            except Exception as e:
+                # Corrupt blob (bad checksum analog): treat exactly
+                # like a lost object so the caller-side recovery
+                # (re-ask the owner / reconstruct) replaces it instead
+                # of surfacing an unpickling error.
+                raise ObjectLostError(
+                    f"object {oid.hex()[:16]} failed to decode "
+                    f"(corrupt): {type(e).__name__}: {e}") from e
             self.memory.put(oid, _Cell("value", value))
             return value
         if cell.kind == "shm":
-            entry = self.shm.get(oid)
+            try:
+                entry = self.shm.get(oid)
+            except Exception as e:
+                self.shm.delete(oid)
+                raise ObjectLostError(
+                    f"object {oid.hex()[:16]} failed to decode from "
+                    f"the shared store (corrupt): "
+                    f"{type(e).__name__}: {e}") from e
             if entry is None:
                 raise ObjectLostError(f"object {oid.hex()[:16]} missing from store")
             self.memory.put(oid, _Cell("value", entry.value))
@@ -1231,7 +1301,10 @@ class Runtime:
             or ref.id in self._chunk_buf
         stale_probes = 0
         chunk_progress = -1
-        lost_retries = 2
+        # Bounded, jittered re-asks for lost/corrupt borrowed objects
+        # (shared backoff module; an immediate hot re-ask of a slow
+        # owner just multiplies its load).
+        lost_backoff = Backoff(base=0.05, cap=0.5, max_attempts=3)
         while True:
             cell_entry = self.memory.get_if_exists(ref.id)
             if cell_entry is not None:
@@ -1241,13 +1314,13 @@ class Runtime:
                     if owner_is_self and self._try_reconstruct(ref.id):
                         self.memory.delete(ref.id)
                         continue
-                    if not owner_is_self and lost_retries > 0:
-                        # Dangling shm cell for a borrowed ref: re-ask the
-                        # owner (it revalidates, reconstructs, or confirms
-                        # the loss).
-                        lost_retries -= 1
+                    if not owner_is_self and lost_backoff.sleep():
+                        # Dangling/corrupt cell for a borrowed ref:
+                        # re-ask the owner (it revalidates,
+                        # reconstructs, or confirms the loss).
                         self.memory.delete(ref.id)
-                        self._request_from_owner(ref)
+                        self._request_from_owner(
+                            ref, timeout=self._owner_rpc_timeout(deadline))
                         continue
                     raise
             entry = self.shm.get(ref.id)
@@ -1258,7 +1331,8 @@ class Runtime:
                         self._owned.move_to_end(ref.id)
                 return entry.value
             if not owner_is_self and not requested:
-                self._request_from_owner(ref)
+                self._request_from_owner(
+                    ref, timeout=self._owner_rpc_timeout(deadline))
                 requested = True
             # Wait for a push (own task result, or owner's pending push);
             # an unproductive round triggers liveness checks instead of
@@ -1281,11 +1355,20 @@ class Runtime:
                     continue
                 # Re-ask the owner: errors the cell if it is unreachable,
                 # re-registers the push promise if it restarted.
-                self._request_from_owner(ref)
+                self._request_from_owner(
+                    ref, timeout=self._owner_rpc_timeout(deadline))
             else:
                 stale_probes += 1
-                if stale_probes >= 2 \
-                        and not self._object_still_expected(ref.id):
+                expected = self._object_still_expected(ref.id)
+                if expected and stale_probes >= 2:
+                    # Local books say a task is producing it, yet two
+                    # unproductive rounds passed: the result may be in
+                    # the computed-but-push-dropped window. Confirm
+                    # with whoever actually tracks the execution (the
+                    # head for head-path tasks; leased tasks have the
+                    # sweeper's worker probe) before trusting the books.
+                    expected = self._producer_confirmed(ref.id)
+                if not expected and stale_probes >= 2:
                     if self._try_reconstruct(ref.id):
                         stale_probes = 0
                         continue
@@ -1293,6 +1376,41 @@ class Runtime:
                         f"object {ref.id.hex()[:16]} is not in any store "
                         "and no task is producing it (result lost or its "
                         "push was dropped; no reconstruction budget/spec)")
+
+    @staticmethod
+    def _owner_rpc_timeout(deadline) -> float:
+        """An owner RPC must never outlive the caller's get() deadline
+        (a wedged owner used to pin get(timeout=1) for the full 60 s
+        rpc window before GetTimeoutError could fire)."""
+        if deadline is None:
+            return 60.0
+        return max(0.05, min(60.0, deadline - time.monotonic()))
+
+    def _producer_confirmed(self, oid: ObjectID) -> bool:
+        """Deep liveness check behind _object_still_expected: when the
+        ONLY evidence that something is producing `oid` is our own
+        in-flight ledger, ask the authority that watched the dispatch.
+        A dropped result push leaves the local ledger claiming
+        in-flight forever — the lost-update hang this breaks."""
+        tid = oid.task_id()
+        with self._pending_lock:
+            if any(tid in pend
+                   for pend in self._pending_to_addr.values()):
+                return True  # actor call: connection death fails it
+        with self._lineage_lock:
+            if tid in self._reconstructing:
+                return True
+            if tid not in self._inflight_tasks:
+                return False
+        with self._lease_lock:
+            if tid in self._leased_tid_addr:
+                return True  # the lease sweeper's worker probe owns it
+        try:
+            reply = self.head.request(
+                {"kind": "task_alive", "task_id": tid}, timeout=10)
+            return bool(reply.get("alive"))
+        except Exception:
+            return True  # can't tell: keep waiting, don't respin work
 
     def _object_still_expected(self, oid: ObjectID) -> bool:
         """True while some task that returns `oid` is known to be running
@@ -1334,7 +1452,7 @@ class Runtime:
         self.head.send({"kind": "submit_task", "spec": spec})
         return True
 
-    def _request_from_owner(self, ref: ObjectRef):
+    def _request_from_owner(self, ref: ObjectRef, timeout: float = 60.0):
         """Ask the owner for the value; on completion the result (or error)
         lands in the memory store, or the value is in the shared store."""
         # Wall clock (time.time): profiler spans across the cluster
@@ -1353,12 +1471,19 @@ class Runtime:
                 conn = self._get_conn(ref.owner_addr)
                 reply = conn.request(
                     {"kind": "get_object", "object_id": ref.id,
-                     "node_id": self.node_id}, timeout=60)
+                     "node_id": self.node_id}, timeout=timeout)
             except (protocol.ConnectionClosed, FileNotFoundError,
                     ConnectionRefusedError):
                 if not self.shm.contains(ref.id):
                     self.memory.put(ref.id, _Cell("error", ObjectLostError(
                         f"owner of {ref.id.hex()[:16]} is unreachable")))
+                return
+            except GetTimeoutError:
+                raise  # caller's own deadline, not an owner verdict
+            except TimeoutError:
+                # Wedged owner (reachable, silent): do NOT poison the
+                # cell with a permanent error — the caller's loop
+                # re-asks, and its own deadline raises GetTimeoutError.
                 return
             except Exception as e:
                 # The owner replied with an error cell (request() re-raises
@@ -1475,14 +1600,18 @@ class Runtime:
         fn = self._fn_cache.get(key)
         if fn is not None:
             return fn
-        for _ in range(100):
+        # Export visibility lag is normally one message behind; the
+        # shared backoff bounds the poll at a deadline instead of a
+        # fixed-cadence spin (backoff.py).
+        b = Backoff(base=0.05, factor=1.5, cap=0.5, deadline_s=15.0)
+        while True:
             reply = self.head.request({"kind": "kv_get", "key": key}, timeout=30)
             if reply["value"] is not None:
                 fn = cloudpickle.loads(reply["value"])
                 self._fn_cache[key] = fn
                 return fn
-            time.sleep(0.05)
-        raise KeyError(f"function {key} not found in GCS")
+            if not b.sleep():
+                raise KeyError(f"function {key} not found in GCS")
 
     def _prepare_args(self, args, kwargs) -> Tuple[List[ArgSpec], Dict[str, ArgSpec]]:
         def one(v) -> ArgSpec:
@@ -1791,6 +1920,78 @@ class Runtime:
                                     "addrs": to_return})
             except protocol.ConnectionClosed:
                 return
+            if self._leased_probe_s > 0:
+                self._probe_stale_leased(now)
+
+    def _probe_stale_leased(self, now: float):
+        """Ask the worker about leased tasks that have produced nothing
+        for RAY_TPU_LEASED_PROBE_S. The worker's liveness ledger tells
+        dropped-dispatch ('unknown': the execute_task never arrived)
+        and lost-update ('done': it ran, the result push was dropped)
+        apart from merely-slow ('running'); both loss shapes resubmit
+        through the head instead of hanging the caller forever."""
+        candidates = []
+        with self._lease_lock:
+            for tid, entry in self._leased_tid_addr.items():
+                addr, t_push = entry[0], entry[1]
+                if now - t_push < self._leased_probe_s:
+                    continue
+                last = self._lease_probe_at.get(tid, 0.0)
+                if now - last < max(1.0, self._leased_probe_s / 2):
+                    continue
+                self._lease_probe_at[tid] = now
+                candidates.append((tid, addr))
+            for tid in [t for t in self._lease_probe_at
+                        if t not in self._leased_tid_addr]:
+                del self._lease_probe_at[tid]
+        for tid, addr in candidates:
+            try:
+                reply = self._get_conn(addr).request(
+                    {"kind": "task_state", "task_id": tid}, timeout=5)
+                state = reply.get("state")
+            except Exception:
+                continue  # connection-death path recovers the worker
+            if state == "running":
+                continue
+            logger.warning(
+                "leased task %s is %s on worker %s (dispatch or result "
+                "push lost); resubmitting through the head",
+                tid.hex()[:16], state, addr)
+            from . import metrics as metrics_mod
+            metrics_mod.inc("leased_tasks_recovered")
+            self._recover_leased_task(tid, addr)
+
+    def _recover_leased_task(self, tid: TaskID, addr: str):
+        """One leased task was lost between caller and a LIVE worker
+        (wire fault): free its pipeline slot and resubmit it on the
+        head path (at-least-once; the push-result dedup makes a racing
+        late original delivery harmless)."""
+        with self._lease_lock:
+            entry = self._leased_tid_addr.pop(tid, None)
+            if entry is None:
+                return
+            self._lease_probe_at.pop(tid, None)
+            pend = self._leased_pending.get(addr)
+            spec = pend.pop(tid, None) if pend is not None else None
+            key = self._lease_by_addr.get(addr)
+            g = self._lease_groups.get(key) if key is not None else None
+            if g is not None:
+                g.leases.get(addr, set()).discard(tid)
+        if spec is None:
+            return
+        if spec.retries_used < spec.max_retries:
+            spec.retries_used += 1
+            spec.leased = False
+            try:
+                self.head.send({"kind": "submit_task", "spec": spec})
+                return
+            except protocol.ConnectionClosed:
+                pass
+        err = WorkerCrashedError(
+            f"leased task {spec.describe()} was lost in flight to "
+            f"worker {addr} and its retry budget is spent")
+        for oid in spec.return_ids():
+            self._on_push_result({"object_id": oid, "error": err})
 
     def _pin_task_args(self, spec: TaskSpec):
         pinned = []
@@ -2036,7 +2237,15 @@ class Runtime:
         elif kind == "get_object":
             self._on_get_object(conn, msg)
         elif kind == "execute_task":
-            self._task_queue.put(msg["spec"])
+            spec = msg["spec"]
+            # Liveness ledger from the moment of arrival: a task deep
+            # in the pipeline queue must answer 'running' to a caller
+            # probe, or the caller would resubmit queued work.
+            with self._exec_state_lock:
+                self._executing_tids.add(spec.task_id)
+            self._task_queue.put(spec)
+        elif kind == "task_state":
+            self._on_task_state(conn, msg)
         elif kind == "push_task":
             self._on_push_task(msg["spec"])
         elif kind == "object_chunk":
@@ -2081,6 +2290,21 @@ class Runtime:
         else:
             logger.warning("runtime: unknown message %s", kind)
 
+    def _on_task_state(self, conn: protocol.Connection, msg: dict):
+        """Caller-side liveness probe for a dispatched task (see
+        _probe_stale_leased): 'running' while queued/executing here,
+        'done' when it completed recently (its result push may be in
+        flight or lost), 'unknown' when the dispatch never arrived."""
+        tid: TaskID = msg["task_id"]
+        with self._exec_state_lock:
+            if tid in self._executing_tids:
+                state = "running"
+            elif tid in self._recent_done:
+                state = "done"
+            else:
+                state = "unknown"
+        conn.reply(msg, state=state)
+
     def _on_push_result(self, msg: dict):
         oid: ObjectID = msg["object_id"]
         if msg.get("in_shm") and not self.shm.contains(oid):
@@ -2094,6 +2318,24 @@ class Runtime:
                 if entry is not None and entry.pending_push is None:
                     entry.pending_push = msg
                     return
+        # Idempotence gate: delivery is at-least-once (duplicated wire
+        # frames, a probe-triggered resubmit racing the original push,
+        # reconstruction racing a slow result). The FIRST delivery
+        # wins and runs the completion bookkeeping exactly once; a
+        # replay must not double-decrement the in-flight count, feed
+        # the lease pipeline twice, or overwrite a delivered value.
+        # One exception: a real result may upgrade an error cell (a
+        # task wrongly declared lost whose result then arrives) —
+        # cell-only, no second round of bookkeeping.
+        upgrade_only = False
+        existing = self.memory.get_if_exists(oid)
+        if existing is not None:
+            prior: _Cell = existing.value
+            if prior.kind != "error" or msg.get("error") is not None:
+                from . import metrics as metrics_mod
+                metrics_mod.inc("push_result_duplicates")
+                return
+            upgrade_only = True
         if msg.get("error") is not None:
             cell = _Cell("error", msg["error"])
         elif msg.get("in_shm"):
@@ -2101,22 +2343,23 @@ class Runtime:
         else:
             cell = _Cell("raw", msg["data"])
         self.memory.put(oid, cell)
-        # Clear pending-actor-task tracking + release arg pins.
-        with self._pending_lock:
-            for pending in self._pending_to_addr.values():
-                pending.pop(oid.task_id(), None)
-        self._unpin_task_args(oid.task_id())
-        with self._lineage_lock:
-            self._reconstructing.discard(oid.task_id())
-            left = self._inflight_tasks.get(oid.task_id())
-            task_complete = left is not None and left <= 1
-            if left is not None:
-                if left <= 1:
-                    self._inflight_tasks.pop(oid.task_id(), None)
-                else:
-                    self._inflight_tasks[oid.task_id()] = left - 1
-        if task_complete or left is None:
-            self._on_leased_result(oid.task_id())
+        if not upgrade_only:
+            # Clear pending-actor-task tracking + release arg pins.
+            with self._pending_lock:
+                for pending in self._pending_to_addr.values():
+                    pending.pop(oid.task_id(), None)
+            self._unpin_task_args(oid.task_id())
+            with self._lineage_lock:
+                self._reconstructing.discard(oid.task_id())
+                left = self._inflight_tasks.get(oid.task_id())
+                task_complete = left is not None and left <= 1
+                if left is not None:
+                    if left <= 1:
+                        self._inflight_tasks.pop(oid.task_id(), None)
+                    else:
+                        self._inflight_tasks[oid.task_id()] = left - 1
+            if task_complete or left is None:
+                self._on_leased_result(oid.task_id())
         # Forward to any borrower that asked before we had it.
         with self._waiters_lock:
             waiters = self._object_waiters.pop(oid, ())
@@ -2267,6 +2510,8 @@ class Runtime:
         """Announce of an inbound striped transfer (ordered ahead of
         any push_result for the same object on the control
         connection)."""
+        if self.shm.contains(msg["object_id"]):
+            return  # replayed begin for an already-sealed object
         with self._chunk_lock:
             entry = self._chunk_buf.setdefault(
                 msg["object_id"], _InboundTransfer(time.time()))
@@ -2276,6 +2521,14 @@ class Runtime:
 
     def _on_object_chunk(self, msg: dict):
         oid: ObjectID = msg["object_id"]
+        if self.shm.contains(oid):
+            # Replayed chunk for an object that already sealed (a
+            # duplicated wire frame, or an overlapping retry stream
+            # finishing after the object completed): landing it again
+            # would re-open a receive buffer that can never fill.
+            from . import metrics as metrics_mod
+            metrics_mod.inc("wire_chunk_duplicates")
+            return
         # Decode on THIS connection's recv thread: decompression of
         # stripes on different transfer connections runs in parallel
         # (zlib/lz4 release the GIL).
@@ -2368,11 +2621,22 @@ class Runtime:
         if channel.startswith("actor:"):
             info = msg["data"]
             aid = info["actor_id"]
+            prev = self._actor_cache.get(aid)
             self._actor_cache[aid] = info
             if info.get("state") in ("ALIVE", "DEAD"):
                 tid = self._actor_creation_tasks.pop(aid, None)
                 if tid is not None:
                     self._unpin_task_args(tid)
+            if info.get("state") in ("RESTARTING", "DEAD"):
+                # The incarnation our in-flight calls were sent to is
+                # gone. The direct connection to it may be HALF-OPEN
+                # (wedged worker, partition) and would never error —
+                # resolve the race to a typed error now, never a
+                # silent hang. RESTARTING surfaces as
+                # ActorUnavailableError (the call may be retried
+                # against the new incarnation); DEAD as ActorDiedError.
+                self._fail_inflight_actor_calls(
+                    aid, (prev or {}).get("addr"), info)
             ev = self._actor_events.get(aid)
             if ev is not None:
                 ev.set()
@@ -2384,6 +2648,37 @@ class Runtime:
             origin = f"{data.get('node', '?')}/{data.get('file', '?')}"
             for line in data.get("lines", ()):
                 print(f"({origin}) {line}", flush=True)
+
+    def _fail_inflight_actor_calls(self, aid: ActorID,
+                                   addr: Optional[str], info: dict):
+        """Error every pending call to a dead/restarting actor
+        incarnation (see _on_publish). `addr` scopes to the old
+        incarnation when known; otherwise every pending call for the
+        actor is resolved."""
+        from ..exceptions import ActorUnavailableError
+        specs = []
+        with self._pending_lock:
+            for a, pend in list(self._pending_to_addr.items()):
+                if addr is not None and a != addr:
+                    continue
+                for tid, spec in list(pend.items()):
+                    if spec.actor_id == aid:
+                        pend.pop(tid, None)
+                        specs.append(spec)
+        if not specs:
+            return
+        if info.get("state") == "DEAD":
+            err = ActorDiedError(
+                aid.hex(), info.get("death_reason", "")
+                or "actor died with calls in flight")
+        else:
+            err = ActorUnavailableError(
+                f"actor {aid.hex()[:16]} is restarting; the in-flight "
+                f"call was dropped with its incarnation and may be "
+                f"retried")
+        for spec in specs:
+            for oid in spec.return_ids():
+                self._on_push_result({"object_id": oid, "error": err})
 
     # ==================================================================
     # execution (worker role)
@@ -2521,9 +2816,49 @@ class Runtime:
             {"task_id": spec.task_id.hex(),
              "flow_id": spec.task_id.hex(), "flow": "f"})
 
+    def _chaos_exec(self, spec: TaskSpec, site: str) -> bool:
+        """Worker-kill / lost-result injection at the execution seams.
+        Returns True when the result push must be skipped
+        (exec.after drop_result); kill kinds do not return."""
+        c = chaos.controller
+        if c is None or self.role != "worker":
+            return False
+        if site == "exec.after" and spec.kind != NORMAL_TASK:
+            # Dropped ACTOR results have no at-least-once replay
+            # protocol (per-caller seq streams are exactly-once);
+            # actor-side chaos is the kill/restart path instead.
+            return False
+        rule = c.fire(site, spec.describe())
+        if rule is None:
+            return False
+        # Mark the injection on the task's lifecycle record so the
+        # recovery latency is visible in `ray_tpu.tasks()` and traces.
+        self.task_events.record(spec.task_id, task_events.ANNOTATE,
+                                chaos=f"{site}:{rule.kind}")
+        if rule.kind == "kill":
+            self.task_events.flush()
+            try:
+                # Final metrics push: the injection counter must not
+                # die with this process (the head folds disconnected
+                # processes' counters into its per-node residue).
+                from . import metrics as metrics_mod
+                snap = metrics_mod.snapshot()
+                self.head.send({"kind": "metrics_push",
+                                "node": self.node_id,
+                                "counters": snap["counters"],
+                                "gauges": snap["gauges"]})
+                time.sleep(0.05)  # let the frame leave the socket
+            except Exception:
+                pass
+            os._exit(137)
+        return rule.kind == "drop_result"
+
     def _execute_one(self, spec: TaskSpec, fn) -> None:
         self._record_exec_state(spec, task_events.RUNNING)
         task_events.set_current_task(spec.task_id)
+        with self._exec_state_lock:
+            self._executing_tids.add(spec.task_id)
+        self._chaos_exec(spec, "exec.before")
         try:
             # Low-memory guard (reference memory_monitor.py:64): fail
             # the task with a typed error instead of letting the OOM
@@ -2532,7 +2867,12 @@ class Runtime:
             with self._exec_span(spec):
                 args, kwargs = self._resolve_args(spec)
                 result = fn(*args, **kwargs)
-            self._deliver_result(spec, result)
+            # The lost-update window: the result exists, the push
+            # hasn't happened. exec.after chaos kills or drops here;
+            # recovery is the caller-side task_state probe (leased) /
+            # head task_alive backstop + reconstruction.
+            if not self._chaos_exec(spec, "exec.after"):
+                self._deliver_result(spec, result)
             self._record_exec_state(spec, task_events.FINISHED)
         except SystemExit as e:
             if spec.kind == ACTOR_TASK:
@@ -2566,6 +2906,9 @@ class Runtime:
                                  node=spec.caller_node)
         finally:
             task_events.set_current_task(None)
+            with self._exec_state_lock:
+                self._executing_tids.discard(spec.task_id)
+                self._recent_done.append(spec.task_id)
 
     def _deliver_result(self, spec: TaskSpec, result):
         n = spec.num_returns
@@ -2647,6 +2990,9 @@ class Runtime:
         for s in parked:
             self._on_push_task(s)
         self._record_exec_state(spec, task_events.FINISHED)
+        with self._exec_state_lock:
+            self._executing_tids.discard(spec.task_id)
+            self._recent_done.append(spec.task_id)
         self.head.send({"kind": "actor_ready", "actor_id": spec.actor_id,
                         "addr": self.addr})
 

@@ -130,6 +130,11 @@ class TaskStateLog:
                     for k in ("wire_bytes", "transfer_bytes"):
                         if ev.get(k) is not None:
                             rec[k] = rec.get(k, 0) + ev[k]
+                    if ev.get("chaos"):
+                        # Chaos-plane injections that hit this task
+                        # ("site:kind"), so per-task recovery latency
+                        # is attributable in `ray_tpu.tasks()`.
+                        rec.setdefault("chaos", []).append(ev["chaos"])
             return
         if state not in _RANK:
             return
@@ -164,7 +169,7 @@ class TaskStateLog:
         out = {k: rec[k] for k in ("task_id", "name", "kind", "state",
                                    "node", "worker_pid", "caller",
                                    "parent_task_id", "error")}
-        for k in ("wire_bytes", "transfer_bytes"):
+        for k in ("wire_bytes", "transfer_bytes", "chaos"):
             if k in rec:
                 out[k] = rec[k]
         out["start"] = events[0][1] if events else None

@@ -110,15 +110,18 @@ class Cluster:
                 pass
 
     def wait_for_nodes(self, n: int, timeout: float = 30.0):
-        """Block until the head sees `n` alive nodes (head node included)."""
-        deadline = time.monotonic() + timeout
-        while time.monotonic() < deadline:
+        """Block until the head sees `n` alive nodes (head node included).
+        Polls on the shared jittered backoff (backoff.py) so a slow
+        agent boot is not hammered at a fixed cadence."""
+        from ._private.backoff import Backoff
+        b = Backoff(base=0.05, factor=1.5, cap=0.5, deadline_s=timeout)
+        while True:
             info = self.node.runtime.cluster_info()
             if len(info["nodes"]) >= n:
                 return
-            time.sleep(0.05)
-        raise TimeoutError(
-            f"cluster did not reach {n} nodes within {timeout}s")
+            if not b.sleep():
+                raise TimeoutError(
+                    f"cluster did not reach {n} nodes within {timeout}s")
 
     def shutdown(self):
         for h in list(self._nodes):

@@ -157,9 +157,15 @@ class Trainer(Trainable):
     # ------------------------------------------------------------------
     def _train(self) -> dict:
         """One training iteration with worker-failure retry (parity:
-        `Trainer.train`, trainer.py:425)."""
+        `Trainer.train`, trainer.py:425). Recovery attempts are bounded
+        and jittered (backoff.py) — recreating workers into the same
+        fault (a node still dying, chaos still injecting) back-to-back
+        just multiplies the failure."""
         import time
-        for attempt in range(3):
+
+        from ray_tpu._private.backoff import Backoff
+        backoff = Backoff(base=0.2, factor=2.0, cap=2.0, max_attempts=3)
+        while True:
             t0 = time.monotonic()
             try:
                 result = self._train_inner()
@@ -169,9 +175,13 @@ class Trainer(Trainable):
             except RayError as e:
                 if not self.config.get("ignore_worker_failures"):
                     raise
+                if backoff.expired():
+                    raise RuntimeError(
+                        "training failed after worker recovery attempts"
+                    ) from e
                 logger.warning("worker failure: %s; recreating workers", e)
+                backoff.sleep()
                 self._recover_workers()
-        raise RuntimeError("training failed after worker recovery attempts")
 
     def _push_train_metrics(self, result: dict, iter_time: float):
         """Per-iteration timing/throughput into the cluster metrics

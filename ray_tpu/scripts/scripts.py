@@ -416,6 +416,57 @@ def cmd_timeline(args):
              if dropped else ""))
 
 
+def cmd_chaos(args):
+    """Chaos-plane tooling: print the injection-site catalog, validate
+    a spec, pretty-print a RAY_TPU_CHAOS_TRACE file from a (failed)
+    run, or verify that the trace replays byte-identical from its seed
+    (`--replay --spec <spec>`), which is how a CI failure's fault
+    sequence is confirmed reproducible before re-running it locally."""
+    from ray_tpu._private import chaos as chaos_mod
+    if args.catalog:
+        for site in sorted(chaos_mod.SITES):
+            print(site)
+            for kind, doc in sorted(chaos_mod.SITES[site].items()):
+                print(f"  {kind:<12s} {doc}")
+        return
+    if args.spec and not args.trace:
+        seed, rules = chaos_mod.parse_spec(args.spec)
+        print(f"seed: {seed}")
+        for r in rules:
+            print(f"  {r.site:<16s} {r.kind:<12s} "
+                  f"{r.trigger}{r.value:g}"
+                  + (f" param={r.param}" if r.param else ""))
+        return
+    if not args.trace:
+        sys.exit("chaos needs a trace file, --spec, or --catalog")
+    entries = chaos_mod.load_trace(args.trace)
+    if args.replay:
+        if not args.spec:
+            sys.exit("--replay needs --spec <the run's RAY_TPU_CHAOS>")
+        replayed = chaos_mod.replay(args.spec, entries)
+        if chaos_mod.trace_bytes(entries) \
+                == chaos_mod.trace_bytes(replayed):
+            print(f"trace replays byte-identical from its seed "
+                  f"({len(entries)} injection(s))")
+            return
+        print("trace DIVERGES from its seed replay:")
+        for a, b in zip(entries, replayed + [None] * len(entries)):
+            if a != b:
+                print(f"  recorded: {a}\n  replayed: {b}")
+        sys.exit(1)
+    print(f"{'pid':<8s} {'seq':<5s} {'site':<16s} {'kind':<12s} "
+          f"{'occ':<5s} detail")
+    for e in entries:
+        print(f"{e['pid']:<8d} {e['seq']:<5d} {e['site']:<16s} "
+              f"{e['kind']:<12s} {e['occ']:<5d} {e.get('detail', '')}")
+    by_kind = {}
+    for e in entries:
+        k = f"{e['site']}:{e['kind']}"
+        by_kind[k] = by_kind.get(k, 0) + 1
+    print(f"{len(entries)} injection(s): " + ", ".join(
+        f"{k} x{n}" for k, n in sorted(by_kind.items())))
+
+
 def cmd_check(args):
     """Framework-aware static analysis (graftcheck): lint rules for
     distributed anti-patterns + static lock-order cycle detection.
@@ -440,6 +491,18 @@ def main(argv=None):
     p.add_argument("--json", action="store_true")
     p.add_argument("--no-lockgraph", action="store_true")
     p.set_defaults(fn=cmd_check)
+
+    p = sub.add_parser(
+        "chaos", help="chaos plane: trace pretty-print / replay-verify")
+    p.add_argument("trace", nargs="?", default=None,
+                   help="RAY_TPU_CHAOS_TRACE JSONL file")
+    p.add_argument("--spec", default=None,
+                   help="chaos spec (validate, or replay against)")
+    p.add_argument("--replay", action="store_true",
+                   help="verify the trace replays from its seed")
+    p.add_argument("--catalog", action="store_true",
+                   help="print the injection-site catalog")
+    p.set_defaults(fn=cmd_chaos)
 
     p = sub.add_parser("start", help="start a head or join as a node")
     p.add_argument("--head", action="store_true")

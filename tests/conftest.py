@@ -32,6 +32,10 @@ def pytest_configure(config):
         "markers",
         "soak: long-running chaos workload (opt-in via RAY_TPU_SOAK=1; "
         "parity: ci/long_running_tests)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long chaos soaks and other tier-2 tests excluded from "
+        "the tier-1 run (-m 'not slow')")
 
 
 @pytest.fixture

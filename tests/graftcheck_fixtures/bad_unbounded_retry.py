@@ -1,0 +1,18 @@
+"""GC107: retry loops with no bound or backoff."""
+
+
+def fetch_forever(conn):
+    while True:
+        try:
+            return conn.fetch()
+        except Exception:
+            continue  # GC107: hot-spins forever on persistent failure
+
+
+def push_forever(q, item):
+    while True:
+        try:
+            q.push(item)
+            return
+        except ConnectionError:
+            continue  # GC107: no sleep, no attempt bound, no deadline

@@ -36,6 +36,25 @@ def service_loop(poll):
             logger.warning("poll failed", exc_info=True)  # logged
 
 
+def fetch_with_backoff(conn, backoff):
+    while True:
+        try:
+            return conn.fetch()
+        except ConnectionError:
+            if not backoff.sleep():  # GC107 twin: bounded + paced
+                raise  # budget spent: surface, don't spin
+
+
+def drain_with_timeout(q, stop):
+    while True:
+        try:
+            return q.get(timeout=0.5)  # GC107 twin: bounded wait paces
+        except LookupError:
+            if stop.is_set():
+                return None
+            continue
+
+
 def cleanup_loop(conns):
     for c in conns:
         try:

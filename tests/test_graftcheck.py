@@ -38,6 +38,7 @@ def _lint_rules(path):
     ("bad_mutable_default.py", "GC104", 3),
     ("bad_swallowed_exception.py", "GC105", 2),
     ("bad_daemon_thread.py", "GC106", 2),
+    ("bad_unbounded_retry.py", "GC107", 2),
 ])
 def test_rule_fires(fixture, rule, count):
     findings = run_lint([_fixture(fixture)])
