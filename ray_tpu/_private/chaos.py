@@ -75,6 +75,12 @@ SITES: Dict[str, Dict[str, str]] = {
     "stripe.send": {
         "abort": "kill the transfer stream mid-stripe (chunk send fails)",
     },
+    "replica.fetch": {
+        "die": "the replica chosen for a location-routed fetch is "
+               "unreachable (fetch falls back to the owner)",
+        "stale": "the replica no longer holds the object (stale "
+                 "directory entry; fetch falls back to the owner)",
+    },
     "exec.before": {
         "kill": "kill the worker process before the task body runs",
     },

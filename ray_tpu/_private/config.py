@@ -75,6 +75,20 @@ _def("RAY_TPU_GET_PREFETCH", int, 8,
      "Parallel fetch window for multi-ref get()/wait(): pending "
      "foreign refs are requested concurrently up to this many at once")
 
+# --- object distribution (location directory + tree broadcast) --------
+_def("RAY_TPU_LOCATION_FETCH", bool, True,
+     "Location-aware object distribution: nodes register sealed "
+     "fetched copies in the head's location directory, fetches prefer "
+     "a local/least-loaded replica over the owner, same-node fetches "
+     "of one object coalesce into a single wire transfer, and owners "
+     "at their upload cap redirect borrowers to a finished replica "
+     "(0 reverts to owner-only point-to-point fetch)")
+_def("RAY_TPU_MAX_UPLOADS_PER_OBJECT", int, 2,
+     "Concurrent outbound transfers of ONE object an owner serves "
+     "before redirecting further borrowers to an already-complete "
+     "replica — the bounded fan-out that turns a 1->N broadcast into "
+     "a tree (only enforced while RAY_TPU_LOCATION_FETCH is on)")
+
 # --- worker leases ----------------------------------------------------
 _def("RAY_TPU_DISABLE_LEASES", bool, False,
      "Route every task through the head instead of worker leases")

@@ -188,6 +188,17 @@ class HeadServer:
         # Dashboard ring buffers (dashboard.py): recent error/log tails.
         self._recent_errors: deque = deque(maxlen=50)
         self._recent_logs: deque = deque(maxlen=200)
+        # Object location directory (parity: the reference
+        # ObjectDirectory over GCS object tables, `object_directory.h`):
+        # oid -> {process addr: node_id} for every node that sealed a
+        # fetched copy. Best-effort — stale entries are tolerated, the
+        # fetch falls back to the owner on a miss. `_grants` counts how
+        # often each replica was handed out as a source, so resolution
+        # can order least-loaded first. Bounded LRU.
+        from collections import OrderedDict as _OD
+        self._obj_locations: "_OD[object, Dict[str, str]]" = _OD()
+        self._obj_location_grants: Dict[str, int] = {}
+        self._obj_locations_max = 4096
         # Per-process metric snapshots pushed by workers/drivers
         # (addr -> {"node":, "counters":, "gauges":}).
         self._metric_snaps: Dict[str, dict] = {}
@@ -284,6 +295,15 @@ class HeadServer:
                     dead[k] = dead.get(k, 0.0) + v
             for subs in self._subs.values():
                 subs.discard(conn)
+            # A dead process's sealed replicas died with its node store
+            # access: drop its directory registrations so fetches stop
+            # routing at it.
+            for oid in list(self._obj_locations):
+                entry = self._obj_locations[oid]
+                if entry.pop(conn.peer_addr, None) is not None \
+                        and not entry:
+                    del self._obj_locations[oid]
+            self._obj_location_grants.pop(conn.peer_addr, None)
         self._release_leases_of(conn.peer_addr)
         if node_id is not None:
             self._handle_node_death(node_id)
@@ -501,6 +521,52 @@ class HeadServer:
                 c.send({"kind": "publish", "channel": channel, "data": data})
             except protocol.ConnectionClosed:
                 pass
+
+    # -- object location directory (distribution plane) ------------------
+    def _h_object_location_add(self, conn, msg):
+        """A node sealed a fetched copy: register it (fire-and-forget)."""
+        oid = msg["object_id"]
+        with self._lock:
+            entry = self._obj_locations.get(oid)
+            if entry is None:
+                entry = self._obj_locations[oid] = {}
+                while len(self._obj_locations) > self._obj_locations_max:
+                    self._obj_locations.popitem(last=False)
+            entry[msg["addr"]] = msg.get("node_id", "")
+
+    def _h_object_location_remove(self, conn, msg):
+        """Eviction/free deregisters the copy (fire-and-forget)."""
+        oid = msg["object_id"]
+        with self._lock:
+            entry = self._obj_locations.get(oid)
+            if entry is not None:
+                entry.pop(msg["addr"], None)
+                if not entry:
+                    del self._obj_locations[oid]
+
+    def _h_object_locations(self, conn, msg):
+        """Resolve an object's replica set, least-loaded first. The
+        head bumps the grant count of the replica it lists first (the
+        borrower's predicted pick), so consecutive borrowers spread
+        over the copies instead of dog-piling one."""
+        oid = msg["object_id"]
+        with self._lock:
+            entry = self._obj_locations.get(oid) or {}
+            locs = sorted(
+                entry.items(),
+                key=lambda kv: self._obj_location_grants.get(kv[0], 0))
+            if locs:
+                first = locs[0][0]
+                self._obj_location_grants[first] = \
+                    self._obj_location_grants.get(first, 0) + 1
+        conn.reply(msg, locations=[{"addr": a, "node": n}
+                                   for a, n in locs])
+
+    def object_location_counts(self) -> Dict[str, int]:
+        """Replica count per tracked object (`ray_tpu stat`, tests)."""
+        with self._lock:
+            return {oid.hex(): len(entry)
+                    for oid, entry in self._obj_locations.items()}
 
     # -- tasks -----------------------------------------------------------
     def _h_submit_task(self, conn, msg):
@@ -906,6 +972,10 @@ class HeadServer:
                     total[k] = total.get(k, 0.0) + v
                 for k, v in n.available.items():
                     avail[k] = avail.get(k, 0.0) + v
+            loc_counts = sorted(
+                ((oid.hex(), len(entry))
+                 for oid, entry in self._obj_locations.items()),
+                key=lambda kv: -kv[1])
             info = {
                 "total_resources": total,
                 "available_resources": avail,
@@ -915,6 +985,13 @@ class HeadServer:
                 "actors": {a.hex(): i.view() for a, i in self._actors.items()},
                 "session_name": self.session_name,
                 "session_dir": self.session_dir,
+                # Distribution plane: how many nodes hold a sealed copy
+                # of each directory-tracked object (top 20 by count).
+                "object_locations": {
+                    "objects": len(self._obj_locations),
+                    "replicas": sum(n for _, n in loc_counts),
+                    "top": loc_counts[:20],
+                },
             }
         conn.reply(msg, info=info)
 

@@ -24,6 +24,15 @@ counters `wire_bytes_on_wire` / `wire_bytes_raw` / `wire_bytes_saved` /
 `wire_stripe_retries`; gauges `wire_stripes_active` (objects currently
 striping out) and `wire_send_mbps` (per-peer throughput EMA summed per
 process — the per_node breakdown keeps it attributable).
+
+Distribution-plane series (location directory + tree broadcast,
+runtime.py): counters `object_fetch_source.owner` / `.replica` /
+`.local_shm` (every borrowed-object fetch attributed to its source),
+`object_fetch_dedup_waits` (same-node fetches coalesced into a
+sibling's wire transfer), `object_fetch_redirects_issued` /
+`object_fetch_redirects_followed` (owner fan-out cap), and
+`object_fetch_replica_fallbacks` (stale/dead replica -> owner); gauge
+`broadcast_fanout` (owner's peak concurrent uploads of one object).
 """
 
 from __future__ import annotations

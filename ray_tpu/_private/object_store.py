@@ -130,12 +130,13 @@ class ReceiveBuffer:
     copies. seal() atomically renames into the store namespace; abort()
     discards the partial file so a failed transfer never surfaces."""
 
-    __slots__ = ("_tmp", "_path", "_fd", "total")
+    __slots__ = ("_tmp", "_path", "_fd", "total", "on_seal")
 
-    def __init__(self, tmp: str, path: str, total: int):
+    def __init__(self, tmp: str, path: str, total: int, on_seal=None):
         self._tmp = tmp
         self._path = path
         self.total = total
+        self.on_seal = on_seal  # fired once, after the rename
         self._fd = os.open(tmp, os.O_CREAT | os.O_RDWR | os.O_TRUNC, 0o600)
         os.ftruncate(self._fd, max(total, 1))
 
@@ -146,6 +147,8 @@ class ReceiveBuffer:
         os.close(self._fd)
         self._fd = -1
         os.rename(self._tmp, self._path)
+        if self.on_seal is not None:
+            self.on_seal()
 
     def abort(self) -> None:
         if self._fd >= 0:
@@ -169,9 +172,20 @@ class SharedObjectStore:
         # Pins: mmaps we must keep open because deserialized values alias them.
         self._pins: Dict[ObjectID, _Pin] = {}
         self._lock = make_lock("SharedObjectStore._lock")
+        # Distribution-plane hooks (runtime.py): on_seal(oid) fires after
+        # any blob lands sealed (local put, fetched copy, striped
+        # receive); on_evict(oid) after delete(). Both run OUTSIDE the
+        # store lock and must be cheap/non-raising.
+        self.on_seal = None
+        self.on_evict = None
 
     def _path(self, oid: ObjectID) -> str:
         return self.prefix + oid.hex()
+
+    def _fire_seal(self, oid: ObjectID) -> None:
+        cb = self.on_seal
+        if cb is not None:
+            cb(oid)
 
     # -- writer side -----------------------------------------------------
     def create_and_seal(self, oid: ObjectID, meta: bytes, buffers, total: int) -> None:
@@ -185,6 +199,7 @@ class SharedObjectStore:
         finally:
             os.close(fd)
         os.rename(tmp, path)  # atomic seal
+        self._fire_seal(oid)
 
     def put_serialized(self, oid: ObjectID, value) -> int:
         meta, buffers, total = serialization.serialize(value)
@@ -206,6 +221,7 @@ class SharedObjectStore:
             for p in parts:
                 f.write(p)
         os.rename(tmp, path)
+        self._fire_seal(oid)
 
     def create_receive(self, oid: ObjectID, total: int) -> "ReceiveBuffer":
         """Pre-sized landing zone for an inbound striped transfer:
@@ -216,7 +232,8 @@ class SharedObjectStore:
         corrupt each other's seal."""
         path = self._path(oid)
         tmp = f"{path}.rx{os.getpid()}-{os.urandom(2).hex()}"
-        return ReceiveBuffer(tmp, path, total)
+        return ReceiveBuffer(tmp, path, total,
+                             on_seal=lambda: self._fire_seal(oid))
 
     def blob_size(self, oid: ObjectID) -> Optional[int]:
         try:
@@ -292,6 +309,54 @@ class SharedObjectStore:
         try:
             os.unlink(self._path(oid))
         except FileNotFoundError:
+            pass
+        cb = self.on_evict
+        if cb is not None:
+            cb(oid)
+
+    # -- per-node fetch claims (single-flight dedup) ---------------------
+    # Concurrent fetches of ONE object by several processes on this node
+    # coalesce: the process that wins the claim file does the wire
+    # transfer; the others wait for its seal and mmap the landed copy.
+    # The claim file carries the claimer's pid so waiters can break a
+    # claim whose holder died mid-fetch.
+    def _claim_path(self, oid: ObjectID) -> str:
+        return self._path(oid) + ".fetch"
+
+    def try_claim_fetch(self, oid: ObjectID) -> bool:
+        try:
+            fd = os.open(self._claim_path(oid),
+                         os.O_CREAT | os.O_EXCL | os.O_WRONLY, 0o600)
+        except FileExistsError:
+            return False
+        except OSError:
+            # Unwritable store dir: dedup degrades to per-process.
+            return True
+        try:
+            os.write(fd, str(os.getpid()).encode())
+        finally:
+            os.close(fd)
+        return True
+
+    def fetch_claim_holder(self, oid: ObjectID) -> Optional[int]:
+        """Claimer's pid; None when no claim exists; 0 when the claim
+        exists but its pid is not readable yet (creation race)."""
+        try:
+            with open(self._claim_path(oid), "rb") as f:
+                raw = f.read().strip()
+        except FileNotFoundError:
+            return None
+        except OSError:
+            return 0
+        try:
+            return int(raw) if raw else 0
+        except ValueError:
+            return 0
+
+    def release_fetch_claim(self, oid: ObjectID) -> None:
+        try:
+            os.unlink(self._claim_path(oid))
+        except OSError:
             pass
 
     def cleanup_session(self) -> None:

@@ -14,6 +14,24 @@ reference's gRPC services — `node_manager.proto:78`, `core_worker.proto:150`).
 Both address forms speak the identical framed protocol, so a worker talks to
 a same-node peer over Unix sockets and a remote-node peer over TCP with no
 code change above this module.
+
+Object-distribution plane messages (runtime.py <-> head.py; parity: the
+reference ObjectDirectory's location pub/sub, `object_directory.h`):
+
+- ``object_location_add`` / ``object_location_remove`` — a node
+  registers/deregisters a sealed fetched copy with the head directory
+  (fire-and-forget; stale entries are tolerated, fetch falls back to
+  the owner on a miss).
+- ``object_locations`` — request/reply resolving an object's replica
+  set, least-loaded first.
+- ``get_object`` may now carry ``no_redirect`` (force the owner to
+  serve) and be answered with ``status="redirect"`` + ``addr``/``node``
+  when the owner is at its ``RAY_TPU_MAX_UPLOADS_PER_OBJECT`` fan-out
+  cap — the bounded-fan-out tree broadcast.
+
+Every Connection additionally keeps ``bytes_sent`` / ``bytes_recv``
+payload totals (per-conn wire accounting; the broadcast tests assert
+owner egress against these and the pool-level roll-ups).
 """
 
 from __future__ import annotations
@@ -188,6 +206,10 @@ class Connection:
         self.peer_addr = peer_addr  # advertised server address of the peer
         self.on_close = on_close
         self.closed = False
+        # Per-conn payload byte totals (monotonic; read without the
+        # send lock — torn reads of a counter are harmless).
+        self.bytes_sent = 0
+        self.bytes_recv = 0
         self._send_lock = make_lock("Connection._send_lock")
         self._seq = 0
         self._seq_lock = make_lock("Connection._seq_lock")
@@ -222,8 +244,11 @@ class Connection:
             with self._send_lock:
                 if buffer is not None:
                     _send_msg_oob(self.sock, payload, buffer)
+                    self.bytes_sent += len(payload) \
+                        + memoryview(buffer).nbytes
                 else:
                     _send_msg(self.sock, payload)
+                    self.bytes_sent += len(payload)
         except (OSError, ConnectionClosed) as e:
             self._handle_close()
             raise ConnectionClosed(str(e)) from e
@@ -296,8 +321,13 @@ class Connection:
         try:
             while True:
                 payload = _recv_msg(self.sock)
-                msg = payload if isinstance(payload, dict) \
-                    else pickle.loads(payload)
+                if isinstance(payload, dict):
+                    msg = payload
+                    data = msg.get("data")
+                    self.bytes_recv += getattr(data, "nbytes", 0) or 0
+                else:
+                    msg = pickle.loads(payload)
+                    self.bytes_recv += len(payload)
                 c = chaos.controller
                 if c is not None and msg.get("kind") != "reply":
                     # Replies are exempt: dropping them only converts a

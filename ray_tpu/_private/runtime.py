@@ -60,6 +60,17 @@ OBJECT_CHUNK_SIZE = 8 * 1024 * 1024
 STRIPE_CHUNK_MIN = 256 * 1024
 
 
+def _pid_alive(pid: int) -> bool:
+    """Is a same-node process still running? (fetch-claim staleness)."""
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except OSError:
+        pass  # EPERM etc: it exists
+    return True
+
+
 class _SendTicket:
     """Completion tracking for one striped object send: counts
     outstanding chunk dispatches, collects failed items for redispatch
@@ -207,6 +218,7 @@ class _TransferPool:
         self._dial_fail_until = 0.0
         self._closed = False
         self.active = 0          # objects currently streaming
+        self.bytes_sent = 0      # cumulative wire payload to this peer
         self.ema_mbps: Optional[float] = None
         # Held by at most one UNCONTENDED small-object send at a time:
         # lets the common case (one or two chunks, nobody else
@@ -291,6 +303,7 @@ class _TransferPool:
     def _account(self, raw_n: int, wire_n: int, dt: float, codec: int):
         from . import metrics as metrics_mod
         with self._lock:
+            self.bytes_sent += wire_n
             if dt > 0:
                 mbps = wire_n / dt / 1e6
                 self.ema_mbps = mbps if self.ema_mbps is None \
@@ -446,7 +459,8 @@ class _InboundTransfer:
     accounting), never chunk bytes."""
 
     __slots__ = ("total", "num", "received", "dest", "t0", "owner_ref",
-                 "retries", "pending_push", "wire_bytes", "raw_bytes")
+                 "retries", "pending_push", "wire_bytes", "raw_bytes",
+                 "source_addr")
 
     def __init__(self, t0: float):
         self.total: Optional[int] = None
@@ -459,6 +473,9 @@ class _InboundTransfer:
         self.pending_push: Optional[dict] = None
         self.wire_bytes = 0
         self.raw_bytes = 0
+        # Peer the stripes are streaming from (location-routed pulls):
+        # an abort marks it as a bad source before the retry re-routes.
+        self.source_addr: Optional[str] = None
 
 
 class _RefTracker:
@@ -903,6 +920,36 @@ class Runtime:
         self._waiters_lock = make_lock("Runtime._waiters_lock")
         self._fetching: Set[ObjectID] = set()
 
+        # --- object-distribution plane (location-aware fetch) ----------
+        # Tentpole: a head-tracked replica directory + routed fetches.
+        # Every node that seals a fetched copy registers it; fetches
+        # prefer a same-node copy (zero wire bytes), then the least-
+        # loaded replica, then the owner; same-node fetches of one
+        # object single-flight through a claim file; owners at their
+        # upload cap redirect borrowers to a finished replica.
+        self._location_fetch = bool(config.get("RAY_TPU_LOCATION_FETCH"))
+        self._max_uploads_per_object = max(
+            1, int(config.get("RAY_TPU_MAX_UPLOADS_PER_OBJECT")))
+        # Replica bookkeeping: sealed foreign copies THIS process
+        # registered in the directory, pull-fetches whose seal should
+        # register (the store seal hook registers exactly those), and
+        # sources that recently failed for an object (skipped on retry).
+        self._replica_lock = make_lock("Runtime._replica_lock")
+        self._replica_oids: Set[ObjectID] = set()
+        self._replica_expected: Set[ObjectID] = set()
+        self._bad_sources: Dict[ObjectID, Set[str]] = {}
+        # Node fetch claims held by this process whose release is
+        # deferred to the stripe seal/abort (guarded by _fetch_lock).
+        self._claimed_fetches: Set[ObjectID] = set()
+        # Owner-side broadcast fan-out: concurrent outbound transfers
+        # per object, plus peers known to hold a complete copy —
+        # redirect targets for borrowers beyond the upload cap.
+        self._uploads_lock = make_lock("Runtime._uploads_lock")
+        self._object_uploads: Dict[ObjectID, int] = {}
+        self._object_sent_to: Dict[ObjectID, list] = {}
+        self.shm.on_seal = self._on_store_seal
+        self.shm.on_evict = self._on_store_evict
+
         # Worker leases (reference: `direct_task_transport.h:36,68,89`):
         # once a lease is granted, normal tasks of that resource shape go
         # caller->worker directly, pipelined, with the head out of the
@@ -1325,6 +1372,12 @@ class Runtime:
                     raise
             entry = self.shm.get(ref.id)
             if entry is not None:
+                if not owner_is_self:
+                    # Foreign ref served straight off the node store (a
+                    # sibling's sealed copy / our earlier fetch): zero
+                    # wire bytes, no owner RPC.
+                    from . import metrics as metrics_mod
+                    metrics_mod.inc("object_fetch_source.local_shm")
                 self.memory.put(ref.id, _Cell("value", entry.value))
                 with self._owned_lock:  # LRU touch
                     if ref.id in self._owned:
@@ -1340,11 +1393,15 @@ class Runtime:
             # push_result used to hang callers forever).
             rem = self._remaining(deadline)
             step = 5.0 if rem is None else min(rem, 5.0)
-            got = self.memory.wait_for(ref.id, step)
-            if got is not None:
-                continue  # decode at loop top (uniform lost handling)
-            if self.shm.contains(ref.id):
-                continue  # sealed without a notification: loop picks it up
+            # wait_threshold's coarse re-check also observes seals by
+            # SAME-NODE siblings (which never signal this process's cv):
+            # a borrower whose duplicate stream was dropped after a
+            # sibling sealed the object picks the copy up within the
+            # 50 ms poll instead of the full re-ask step.
+            ready = self.memory.wait_threshold(
+                [ref.id], 1, step, extra_ready=self.shm.contains)
+            if ready:
+                continue  # decode / shm pickup at loop top
             if not owner_is_self:
                 # A striped transfer that is still advancing is healthy.
                 with self._chunk_lock:
@@ -1453,71 +1510,142 @@ class Runtime:
         return True
 
     def _request_from_owner(self, ref: ObjectRef, timeout: float = 60.0):
-        """Ask the owner for the value; on completion the result (or error)
-        lands in the memory store, or the value is in the shared store."""
+        """Fetch a foreign object from the best source; on completion
+        the result (or error) lands in the memory store, or the value is
+        in the shared store. Routing order (the distribution tentpole):
+
+        1. local probe — a copy already sealed in THIS node's shared
+           store (by us or a sibling process) short-circuits everything:
+           no owner RPC, zero wire bytes;
+        2. per-node single-flight — concurrent fetches of one object by
+           several processes on this node coalesce behind a claim file;
+           the losers park until the winner's seal and mmap the copy;
+        3. location routing — the head directory names replicas; prefer
+           the least-loaded one over the owner (stale entries fall back
+           to the owner transparently);
+        4. the owner — which may itself answer with a redirect to a
+           finished replica when it is at its upload fan-out cap.
+        """
+        from . import metrics as metrics_mod
+        deadline = time.monotonic() + max(0.05, timeout)
+        claimed = False
+        try:
+            while True:
+                if self.shm.contains(ref.id):
+                    # Sealed locally (same-node replica / own earlier
+                    # fetch): direct shm mmap, no RPC at all.
+                    self.memory.put(ref.id, _Cell("shm"))
+                    metrics_mod.inc("object_fetch_source.local_shm")
+                    return
+                if self.memory.contains(ref.id):
+                    return  # a push/result landed meanwhile
+                if not self._routed_fetch_eligible(ref):
+                    break
+                if self.shm.try_claim_fetch(ref.id):
+                    claimed = True
+                    break
+                # Another process on this node is already pulling this
+                # object: wait for its seal instead of duplicating the
+                # wire transfer.
+                if self._await_node_fetch(ref, deadline) == "timeout":
+                    return
+                # 'done' / 'retry': re-probe, re-contend.
+            status = self._fetch_once(ref, timeout)
+            if status == "chunked" and claimed:
+                # Stripes are still landing: the claim is released at
+                # the seal/abort, not here.
+                with self._fetch_lock:
+                    self._claimed_fetches.add(ref.id)
+                claimed = False
+        finally:
+            if claimed:
+                self.shm.release_fetch_claim(ref.id)
+            with self._fetch_lock:
+                self._fetching.discard(ref.id)
+
+    def _routed_fetch_eligible(self, ref: ObjectRef) -> bool:
+        """Directory lookup, replica registration and the per-node
+        single-flight claim only pay off for large objects whose owner
+        may live on ANOTHER node (tcp). A unix-socket owner is on this
+        node by construction: its sealed copy is already visible
+        through the shared store, so the plain owner RPC path stays
+        untouched (zero added head round-trips in single-node
+        sessions). Task-result refs carry no size hint and keep the
+        push-promise path."""
+        return (self._location_fetch
+                and ref.size_hint > INLINE_OBJECT_MAX
+                and protocol.is_tcp(ref.owner_addr))
+
+    def _await_node_fetch(self, ref: ObjectRef, deadline: float) -> str:
+        """Park behind a sibling process's in-flight fetch of `ref`.
+        Returns 'done' (sealed, or our own cell filled), 'retry' (the
+        claim vanished or its holder died without sealing — contend
+        again), or 'timeout' (caller's budget exhausted)."""
+        from . import metrics as metrics_mod
+        metrics_mod.inc("object_fetch_dedup_waits")
+        step = 0.005
+        while True:
+            if self.shm.contains(ref.id) or self.memory.contains(ref.id):
+                return "done"
+            holder = self.shm.fetch_claim_holder(ref.id)
+            if holder is None:
+                return "retry"
+            if holder > 0 and not _pid_alive(holder):
+                # The claimer died mid-fetch: break its claim so one of
+                # the waiters takes over.
+                self.shm.release_fetch_claim(ref.id)
+                return "retry"
+            if time.monotonic() >= deadline:
+                return "timeout"
+            time.sleep(step)
+            step = min(0.05, step * 1.5)
+
+    def _fetch_once(self, ref: ObjectRef, timeout: float):
+        """One routed fetch attempt: replica first (when the directory
+        names one), owner as the fallback and authority, with one
+        redirect hop honored. Returns the terminal reply status."""
+        from . import metrics as metrics_mod
         # Wall clock (time.time): profiler spans across the cluster
         # merge into one Chrome trace, so every span must share the
         # epoch the other categories use. Pre-register the start so a
         # chunked reply's span covers the full request round-trip (the
         # chunk stream races this thread's reply handling).
-        t_req = time.time()
         with self._chunk_lock:
             entry = self._chunk_buf.setdefault(
-                ref.id, _InboundTransfer(t_req))
+                ref.id, _InboundTransfer(time.time()))
             entry.owner_ref = ref  # lets an aborted stripe retry itself
+        if self._routed_fetch_eligible(ref):
+            # The seal hook registers exactly the pulls marked here.
+            with self._replica_lock:
+                self._replica_expected.add(ref.id)
         status = None
         try:
-            try:
-                conn = self._get_conn(ref.owner_addr)
-                reply = conn.request(
-                    {"kind": "get_object", "object_id": ref.id,
-                     "node_id": self.node_id}, timeout=timeout)
-            except (protocol.ConnectionClosed, FileNotFoundError,
-                    ConnectionRefusedError):
-                if not self.shm.contains(ref.id):
-                    self.memory.put(ref.id, _Cell("error", ObjectLostError(
-                        f"owner of {ref.id.hex()[:16]} is unreachable")))
-                return
-            except GetTimeoutError:
-                raise  # caller's own deadline, not an owner verdict
-            except TimeoutError:
-                # Wedged owner (reachable, silent): do NOT poison the
-                # cell with a permanent error — the caller's loop
-                # re-asks, and its own deadline raises GetTimeoutError.
-                return
-            except Exception as e:
-                # The owner replied with an error cell (request() re-raises
-                # it); an errored object counts as "ready" for wait()/get().
-                self.memory.put(ref.id, _Cell("error", e))
-                return
-            status = reply["status"]
-            if status == "inline":
-                self.memory.put(ref.id, _Cell("raw", reply["data"]))
-            elif status == "blob":
-                # Cross-node single-message transfer: land the serialized
-                # bytes in OUR shared store so same-node peers share it.
-                self.shm.put_blob(ref.id, reply["data"])
-                self.memory.put(ref.id, _Cell("shm"))
-                self.profiler.record(
-                    "transfer", f"pull {ref.id.hex()[:12]}", t_req,
-                    time.time(),
-                    {"bytes": len(reply["data"]),
-                     "flow_id": ref.id.task_id().hex(), "flow": "t"})
-            elif status == "shm":
-                self.memory.put(ref.id, _Cell("shm"))
-            elif status == "lost":
-                self.memory.put(ref.id, _Cell("error", ObjectLostError(
-                    f"object {ref.id.hex()[:16]} was lost")))
-            # 'pending': owner will push_result when sealed.
-            # 'chunked': object_chunk stripes follow on the owner's
-            # transfer connections (and/or the control connection); the
-            # chunk handler seals into the local store when complete.
-            elif status == "chunked":
-                with self._chunk_lock:
-                    e = self._chunk_buf.get(ref.id)
-                    if e is not None and e.total is None:
-                        e.total = reply["total"]
-                        e.num = reply["num_chunks"]
+            source = self._pick_fetch_source(ref)
+            if source is not None:
+                status = self._fetch_from(ref, source, timeout,
+                                          replica=True)
+                if status is not None:
+                    return status
+                # Stale directory entry or dead/refusing replica:
+                # transparent fallback to the owner.
+                metrics_mod.inc("object_fetch_replica_fallbacks")
+                self._note_bad_source(ref.id, source)
+            status = self._fetch_from(ref, ref.owner_addr, timeout,
+                                      replica=False)
+            if isinstance(status, tuple):  # ("redirect", addr)
+                target = status[1]
+                metrics_mod.inc("object_fetch_redirects_followed")
+                status = self._fetch_from(ref, target, timeout,
+                                          replica=True)
+                if status is None:
+                    # Redirect target gone/evicted: the owner must
+                    # serve (no_redirect forces it past the cap).
+                    metrics_mod.inc("object_fetch_replica_fallbacks")
+                    self._note_bad_source(ref.id, target)
+                    status = self._fetch_from(ref, ref.owner_addr,
+                                              timeout, replica=False,
+                                              no_redirect=True)
+            return status
         finally:
             if status != "chunked":
                 # Drop the pre-registered transfer-start entry (only a
@@ -1529,8 +1657,180 @@ class Runtime:
                     if buf is not None and not buf.received \
                             and buf.total is None:
                         del self._chunk_buf[ref.id]
-            with self._fetch_lock:
-                self._fetching.discard(ref.id)
+                with self._replica_lock:
+                    self._replica_expected.discard(ref.id)
+
+    def _fetch_from(self, ref: ObjectRef, addr: str, timeout: float,
+                    replica: bool, no_redirect: bool = False):
+        """Issue one get_object to `addr` and land the reply. For the
+        owner (replica=False) failures poison the cell exactly as the
+        pre-directory wire did; for a replica every failure shape
+        returns None so the caller falls back to the owner — a replica
+        is never authoritative about loss."""
+        from . import metrics as metrics_mod
+        oid = ref.id
+        if replica:
+            c = chaos.controller
+            if c is not None:
+                rule = c.fire("replica.fetch",
+                              f"{oid.hex()[:12]} {addr}")
+                if rule is not None:
+                    # 'die' (replica unreachable) and 'stale' (replica
+                    # no longer holds the object): both force the
+                    # owner fallback before any byte lands — no
+                    # partial seal is possible.
+                    return None
+        t_req = time.time()
+        try:
+            conn = self._get_conn(addr)
+            req = {"kind": "get_object", "object_id": oid,
+                   "node_id": self.node_id}
+            if no_redirect:
+                req["no_redirect"] = True
+            reply = conn.request(req, timeout=timeout)
+        except (protocol.ConnectionClosed, FileNotFoundError,
+                ConnectionRefusedError):
+            if replica:
+                return None
+            if not self.shm.contains(oid):
+                self.memory.put(oid, _Cell("error", ObjectLostError(
+                    f"owner of {oid.hex()[:16]} is unreachable")))
+            return "unreachable"
+        except GetTimeoutError:
+            raise  # caller's own deadline, not a source verdict
+        except TimeoutError:
+            # Wedged source (reachable, silent). For the owner: do NOT
+            # poison the cell — the caller's loop re-asks, and its own
+            # deadline raises GetTimeoutError.
+            return None if replica else "wedged"
+        except Exception as e:
+            if replica:
+                return None
+            # The owner replied with an error cell (request() re-raises
+            # it); an errored object counts as "ready" for wait()/get().
+            self.memory.put(oid, _Cell("error", e))
+            return "error"
+        status = reply["status"]
+        if status == "redirect":
+            # Only the owner redirects; a replica answering with one is
+            # stale state — treat as a failed source.
+            return None if replica else ("redirect", reply["addr"])
+        if replica and status not in ("inline", "blob", "shm",
+                                      "chunked"):
+            # 'lost'/'error'/'pending' from a replica: the directory
+            # entry is stale; only the owner may declare loss or
+            # promise a push.
+            return None
+        if status == "inline":
+            self.memory.put(oid, _Cell("raw", reply["data"]))
+        elif status == "blob":
+            # Cross-node single-message transfer: land the serialized
+            # bytes in OUR shared store so same-node peers share it
+            # (the seal hook registers the copy in the directory).
+            self.shm.put_blob(oid, reply["data"])
+            self.memory.put(oid, _Cell("shm"))
+            self.profiler.record(
+                "transfer", f"pull {oid.hex()[:12]}", t_req,
+                time.time(),
+                {"bytes": len(reply["data"]), "peer": addr,
+                 "flow_id": oid.task_id().hex(), "flow": "t"})
+        elif status == "shm":
+            self.memory.put(oid, _Cell("shm"))
+        elif status == "lost":
+            self.memory.put(oid, _Cell("error", ObjectLostError(
+                f"object {oid.hex()[:16]} was lost")))
+        # 'pending': owner will push_result when sealed.
+        # 'chunked': object_chunk stripes follow on the source's
+        # transfer connections (and/or the control connection); the
+        # chunk handler seals into the local store when complete.
+        elif status == "chunked":
+            with self._chunk_lock:
+                e = self._chunk_buf.get(oid)
+                if e is not None:
+                    if e.total is None:
+                        e.total = reply["total"]
+                        e.num = reply["num_chunks"]
+                    e.source_addr = addr
+        if status in ("inline", "blob", "shm", "chunked"):
+            metrics_mod.inc("object_fetch_source.replica" if replica
+                            else "object_fetch_source.owner")
+        return status
+
+    def _pick_fetch_source(self, ref: ObjectRef) -> Optional[str]:
+        """Resolve `ref`'s replica set from the head directory and pick
+        the best non-local source, or None to go straight to the owner.
+        Same-node entries are skipped — the local probe already covers
+        them with a direct mmap."""
+        if not self._routed_fetch_eligible(ref):
+            return None
+        try:
+            reply = self.head.request(
+                {"kind": "object_locations", "object_id": ref.id},
+                timeout=5)
+        except Exception:
+            return None  # directory unavailable: owner path
+        with self._replica_lock:
+            bad = set(self._bad_sources.get(ref.id, ()))
+        for loc in reply.get("locations") or ():
+            addr = loc.get("addr")
+            if not addr or addr == self.addr \
+                    or addr == ref.owner_addr or addr in bad:
+                continue
+            if loc.get("node") == self.node_id:
+                continue
+            return addr  # head orders least-loaded first
+        return None
+
+    def _note_bad_source(self, oid: ObjectID, addr: Optional[str]):
+        if not addr:
+            return
+        with self._replica_lock:
+            if len(self._bad_sources) > 256:  # leak bound
+                self._bad_sources.clear()
+            self._bad_sources.setdefault(oid, set()).add(addr)
+
+    def _drop_fetch_claim(self, oid: ObjectID):
+        """Release a node fetch claim whose lifetime was extended to
+        the stripe seal/abort."""
+        with self._fetch_lock:
+            held = oid in self._claimed_fetches
+            self._claimed_fetches.discard(oid)
+        if held:
+            self.shm.release_fetch_claim(oid)
+
+    # -- replica directory hooks (store seal/evict) ---------------------
+    def _on_store_seal(self, oid: ObjectID):
+        """Shared-store seal hook: a pull-fetched foreign copy just
+        landed — register it in the head's location directory so other
+        nodes can fetch from us instead of the owner."""
+        with self._replica_lock:
+            expected = oid in self._replica_expected
+            self._replica_expected.discard(oid)
+            self._bad_sources.pop(oid, None)
+            if expected:
+                self._replica_oids.add(oid)
+        if expected:
+            try:
+                self.head.send({"kind": "object_location_add",
+                                "object_id": oid, "addr": self.addr,
+                                "node_id": self.node_id})
+            except Exception:
+                pass  # directory is best-effort; owner stays reachable
+
+    def _on_store_evict(self, oid: ObjectID):
+        """Shared-store delete hook: deregister a replica we had
+        published (free(), chaos evict, corrupt-blob recovery). Stale
+        entries that slip through are tolerated — fetch falls back to
+        the owner on a miss."""
+        with self._replica_lock:
+            was = oid in self._replica_oids
+            self._replica_oids.discard(oid)
+        if was:
+            try:
+                self.head.send({"kind": "object_location_remove",
+                                "object_id": oid, "addr": self.addr})
+            except Exception:
+                pass
 
     def wait(self, refs: List[ObjectRef], num_returns: int = 1,
              timeout: Optional[float] = None) -> Tuple[list, list]:
@@ -2198,6 +2498,17 @@ class Runtime:
             pool = self._transfer_pools.pop(conn.peer_addr, None)
         if pool is not None:
             pool.close()
+        with self._uploads_lock:
+            # A dead peer's sealed copies are gone with it: stop
+            # redirecting borrowers at it (the head directory drops its
+            # registrations through the same connection-close edge).
+            for oid in list(self._object_sent_to):
+                sent = [(a, n) for a, n in self._object_sent_to[oid]
+                        if a != conn.peer_addr]
+                if sent:
+                    self._object_sent_to[oid] = sent
+                else:
+                    del self._object_sent_to[oid]
         self._drop_peer_pins(conn.peer_addr)
         self._fail_pending_for_addr(conn.peer_addr)
         with self._lease_lock:
@@ -2368,7 +2679,7 @@ class Runtime:
                 if msg.get("in_shm") and node != self.node_id:
                     # The borrower can't see our shared store: stream the
                     # sealed bytes ahead of the (ordered) push_result.
-                    self._send_shm_to(addr, oid)
+                    self._send_shm_to(addr, oid, node)
                 self._get_conn(addr).send(msg)
             except (protocol.ConnectionClosed, FileNotFoundError,
                     ConnectionRefusedError):
@@ -2455,17 +2766,98 @@ class Runtime:
             return pool
 
     def _stream_object(self, addr: str, oid: ObjectID, parts,
-                       total: int, num: int) -> None:
+                       total: int, num: int, peer_node: str = "") -> None:
         """Single protocol point for all outbound transfer paths:
         stripe the chunk iterator across the peer's transfer pool and
-        record the sender-side transfer span."""
+        record the sender-side transfer span. A completed delivery is
+        remembered as a redirect target for this object's broadcast
+        tree (`_record_sent`)."""
         t0 = time.time()
         acct = self._get_transfer_pool(addr).send_object(
             oid, parts, total, num)
+        self._record_sent(oid, addr, peer_node)
         self.profiler.record(
             "transfer", f"push {oid.hex()[:12]}", t0, time.time(),
             {"bytes": total, "chunks": num, "peer": addr, **acct,
              "flow_id": oid.task_id().hex(), "flow": "t"})
+
+    # -- broadcast fan-out (owner side) ---------------------------------
+    def _try_begin_upload(self, oid: ObjectID) -> bool:
+        """Take one outbound-transfer slot for `oid`. False means the
+        object is already at RAY_TPU_MAX_UPLOADS_PER_OBJECT concurrent
+        transfers (only enforced while location fetch is on — the
+        owner-only arm stays unbounded point-to-point)."""
+        from . import metrics as metrics_mod
+        with self._uploads_lock:
+            n = self._object_uploads.get(oid, 0)
+            if self._location_fetch \
+                    and n >= self._max_uploads_per_object:
+                return False
+            self._object_uploads[oid] = n + 1
+            fanout = max(self._object_uploads.values())
+        metrics_mod.set_gauge("broadcast_fanout", float(fanout))
+        return True
+
+    def _begin_upload_forced(self, oid: ObjectID):
+        from . import metrics as metrics_mod
+        with self._uploads_lock:
+            self._object_uploads[oid] = \
+                self._object_uploads.get(oid, 0) + 1
+            fanout = max(self._object_uploads.values())
+        metrics_mod.set_gauge("broadcast_fanout", float(fanout))
+
+    def _end_upload(self, oid: ObjectID):
+        from . import metrics as metrics_mod
+        with self._uploads_lock:
+            n = self._object_uploads.get(oid, 1) - 1
+            if n <= 0:
+                self._object_uploads.pop(oid, None)
+            else:
+                self._object_uploads[oid] = n
+            fanout = max(self._object_uploads.values(), default=0)
+        metrics_mod.set_gauge("broadcast_fanout", float(fanout))
+
+    def _record_sent(self, oid: ObjectID, addr: str, node: str):
+        """Remember that `addr` (on `node`) holds a complete copy —
+        the redirect targets a capped owner hands out."""
+        if not self._location_fetch:
+            return
+        with self._uploads_lock:
+            sent = self._object_sent_to.setdefault(oid, [])
+            if all(a != addr for a, _ in sent):
+                sent.append((addr, node))
+                del sent[:-8]  # bound per-object fan-in memory
+
+    def _redirect_target(self, oid: ObjectID,
+                         exclude: str) -> Optional[tuple]:
+        """Pick a finished replica for a redirect (rotating through the
+        known copies so consecutive borrowers land on different
+        sources — the tree stays balanced)."""
+        with self._uploads_lock:
+            sent = self._object_sent_to.get(oid)
+            if not sent:
+                return None
+            for i, (addr, node) in enumerate(sent):
+                if addr != exclude and addr != self.addr:
+                    sent.append(sent.pop(i))  # rotate
+                    return (addr, node)
+        return None
+
+    def wire_egress_by_peer(self) -> Dict[str, int]:
+        """Cumulative wire payload bytes shipped per peer (control +
+        transfer connections): the per-conn egress ledger the broadcast
+        tests assert owner fan-out against."""
+        out: Dict[str, int] = {}
+        with self._conns_lock:
+            conns = list(self._conns.items())
+            pools = list(self._transfer_pools.items())
+        for addr, c in conns:
+            out[addr] = out.get(addr, 0) + c.bytes_sent
+        for addr, c in list(self.server.connections.items()):
+            out[addr] = out.get(addr, 0) + c.bytes_sent
+        for addr, p in pools:
+            out[addr] = out.get(addr, 0) + p.bytes_sent
+        return out
 
     def _reply_blob(self, conn: protocol.Connection, msg: dict,
                     oid: ObjectID):
@@ -2473,18 +2865,37 @@ class Runtime:
         message when small, a striped chunk stream read incrementally
         from the sealed file when large — the whole blob is never
         materialized (reference: ObjectManager chunked Push,
-        `object_manager.h:183`)."""
+        `object_manager.h:183`). Large objects honor the broadcast
+        fan-out cap: at RAY_TPU_MAX_UPLOADS_PER_OBJECT concurrent
+        transfers, further borrowers are redirected to a finished
+        replica, so a 1->N broadcast self-organizes into a tree."""
+        from . import metrics as metrics_mod
         size = self.shm.blob_size(oid)
         if size is None:
             self._reply_lost_or_reconstruct(conn, msg, oid)
             return
+        peer_node = msg.get("node_id", "")
         if size <= self._stripe_min:
             blob = self.shm.read_blob(oid)
             if blob is None:
                 self._reply_lost_or_reconstruct(conn, msg, oid)
                 return
             conn.reply(msg, status="blob", data=blob)
+            self._record_sent(oid, conn.peer_addr, peer_node)
             return
+        if not self._try_begin_upload(oid):
+            target = None
+            if not msg.get("no_redirect"):
+                target = self._redirect_target(oid,
+                                               exclude=conn.peer_addr)
+            if target is not None:
+                metrics_mod.inc("object_fetch_redirects_issued")
+                conn.reply(msg, status="redirect", addr=target[0],
+                           node=target[1])
+                return
+            # No finished replica to point at (or the borrower already
+            # bounced off one): serve past the cap rather than stall.
+            self._begin_upload_forced(oid)
         chunk = self._transfer_chunk_size(size)
         num = (size + chunk - 1) // chunk
         conn.reply(msg, status="chunked", total=size, num_chunks=num)
@@ -2493,9 +2904,12 @@ class Runtime:
             try:
                 self._stream_object(
                     conn.peer_addr, oid,
-                    self.shm.read_blob_chunks(oid, chunk), size, num)
+                    self.shm.read_blob_chunks(oid, chunk), size, num,
+                    peer_node=peer_node)
             except (protocol.ConnectionClosed, OSError):
                 pass
+            finally:
+                self._end_upload(oid)
         if num <= 4:
             # Few chunks: stream inline from this (recv-loop) thread —
             # the worker-pool dispatch absorbs them without blocking,
@@ -2564,7 +2978,8 @@ class Runtime:
             if done and self._chunk_buf.get(oid) is entry:
                 del self._chunk_buf[oid]
         if done:
-            entry.dest.seal()
+            entry.dest.seal()  # fires the store seal hook (directory)
+            self._drop_fetch_claim(oid)
             self.memory.put(oid, _Cell("shm"))
             from . import metrics as metrics_mod
             metrics_mod.inc("wire_bytes_recv", entry.wire_bytes)
@@ -2598,6 +3013,14 @@ class Runtime:
             return
         if entry.dest is not None:
             entry.dest.abort()
+        # The node fetch claim (if we held one) and the expected-seal
+        # mark die with the partial object; the source that failed
+        # mid-transfer is skipped when the retry re-routes.
+        self._drop_fetch_claim(oid)
+        with self._replica_lock:
+            self._replica_expected.discard(oid)
+        if entry.source_addr is not None:
+            self._note_bad_source(oid, entry.source_addr)
         ref = entry.owner_ref
         if ref is not None and entry.retries < 2:
             with self._chunk_lock:
@@ -2748,7 +3171,8 @@ class Runtime:
                     self._stream_object(
                         addr, oid,
                         serialization.iter_blob_chunks(
-                            meta, buffers, total, chunk), total, num)
+                            meta, buffers, total, chunk), total, num,
+                        peer_node=node)
                 except (protocol.ConnectionClosed, FileNotFoundError,
                         ConnectionRefusedError, OSError):
                     logger.warning("could not stream result %s to %s",
@@ -2770,7 +3194,7 @@ class Runtime:
                 ConnectionRefusedError, OSError):
             logger.warning("could not stream object %s to %s", oid, addr)
 
-    def _send_shm_to(self, addr: str, oid: ObjectID):
+    def _send_shm_to(self, addr: str, oid: ObjectID, node: str = ""):
         """Stripe a sealed shared-store object to a cross-node peer,
         reading the file incrementally."""
         size = self.shm.blob_size(oid)
@@ -2781,7 +3205,7 @@ class Runtime:
         try:
             self._stream_object(
                 addr, oid, self.shm.read_blob_chunks(oid, chunk),
-                size, num)
+                size, num, peer_node=node)
         except (protocol.ConnectionClosed, FileNotFoundError,
                 ConnectionRefusedError, OSError):
             logger.warning("could not stream object %s to %s", oid, addr)
@@ -3191,6 +3615,11 @@ class Runtime:
         self.server.close()
         with self._fetch_lock:
             fetch_pool, self._fetch_pool = self._fetch_pool, None
+            claims = list(self._claimed_fetches)
+            self._claimed_fetches.clear()
+        for oid in claims:
+            # Unblock sibling-process waiters parked on our claims.
+            self.shm.release_fetch_claim(oid)
         if fetch_pool is not None:
             fetch_pool.shutdown(wait=False)
         with self._conns_lock:

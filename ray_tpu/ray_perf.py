@@ -178,14 +178,103 @@ def transfer_benchmarks(quick: bool = False):
     return results
 
 
+def broadcast_benchmarks(quick: bool = False,
+                         location_fetch: bool = True,
+                         borrowers: int = 4,
+                         sizes=(2 << 20, 32 << 20)):
+    """1 owner -> N borrower weight-broadcast shape (the RLlib
+    set_weights fan-out): the driver puts an incompressible blob, N
+    borrower actors on a second node fetch it concurrently. Reports
+    broadcast latency AND the owner's wire egress per broadcast — the
+    quantity the location directory / per-node dedup / redirect tree
+    attack (owner-only: N blobs; location-aware: ~1 per node)."""
+    import statistics
+
+    import ray_tpu
+    from ray_tpu._private import config as config_mod
+    from ray_tpu._private import metrics as metrics_mod
+    from ray_tpu.cluster_utils import Cluster
+
+    # Registry-mediated env overrides so spawned nodes/workers inherit
+    # the arm (scripts stat --config shows them as overridden).
+    config_mod.set_override("RAY_TPU_LOCATION_FETCH",
+                            "1" if location_fetch else "0")
+    config_mod.set_override("RAY_TPU_WIRE_COMPRESSION", "off")
+    results = {}
+    cluster = Cluster(head_resources={"CPU": 2})
+    cluster.add_node(resources={"CPU": 2, "BCAST": float(borrowers)})
+
+    @ray_tpu.remote(resources={"BCAST": 1})
+    class Fetcher:
+        def fetch(self, value):  # ref arg auto-resolves = the fetch
+            return int(value.nbytes)
+
+    fleet = [Fetcher.remote() for _ in range(borrowers)]
+    rng = np.random.default_rng(0)
+    warm = ray_tpu.put(rng.integers(0, 256, 1 << 20, dtype=np.uint8))
+    ray_tpu.get([f.fetch.remote(warm) for f in fleet], timeout=120)
+    cycles = 2 if quick else 6
+    arm = "loc" if location_fetch else "owner"
+    for size in sizes:
+        times, egress = [], []
+        for _ in range(cycles):
+            blob = rng.integers(0, 256, size, dtype=np.uint8)
+            before = metrics_mod.snapshot()["counters"].get(
+                "wire_bytes_on_wire", 0.0)
+            t0 = time.perf_counter()
+            ref = ray_tpu.put(blob)
+            out = ray_tpu.get([f.fetch.remote(ref) for f in fleet],
+                              timeout=180)
+            dt = time.perf_counter() - t0
+            assert all(n == size for n in out)
+            times.append(dt)
+            egress.append(metrics_mod.snapshot()["counters"].get(
+                "wire_bytes_on_wire", 0.0) - before)
+            del ref, blob
+        mb = size >> 20
+        results[f"bcast_{mb}mb_{arm}_ms"] = \
+            1e3 * statistics.median(times)
+        results[f"bcast_{mb}mb_{arm}_egress_mb"] = \
+            statistics.median(egress) / (1 << 20)
+        # Raw cycles so interleaved A/B runs can pool medians across
+        # alternating cluster boots (round-6 variance protocol).
+        results[f"bcast_{mb}mb_{arm}_times_ms"] = \
+            [1e3 * t for t in times]
+        results[f"bcast_{mb}mb_{arm}_egress_raw_mb"] = \
+            [e / (1 << 20) for e in egress]
+        print(f"broadcast {mb:>3d} MB x{borrowers} [{arm:>5s}]   "
+              f"{1e3 * statistics.median(times):>9.1f} ms   "
+              f"owner egress {statistics.median(egress) / (1 << 20):.1f}"
+              f" MB")
+    cluster.shutdown()
+    return results
+
+
+def broadcast_ab(quick: bool = False, cycles: int = 1):
+    """Interleaved same-session A/B: owner-only vs location-aware arms
+    alternate cluster boots (PERF.md round-7 protocol)."""
+    out = []
+    for i in range(cycles):
+        for loc in (False, True):
+            print(f"--- cycle {i} arm={'loc' if loc else 'owner'} ---")
+            out.append(broadcast_benchmarks(quick=quick,
+                                            location_fetch=loc))
+    return out
+
+
 if __name__ == "__main__":
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
     parser.add_argument("--transfer-only", action="store_true",
                         help="run only the cross-node data-plane "
                              "benchmarks (A/B runs)")
+    parser.add_argument("--broadcast", action="store_true",
+                        help="run only the 1->N broadcast benchmark "
+                             "(both arms, interleaved)")
     args = parser.parse_args()
-    if args.transfer_only:
+    if args.broadcast:
+        broadcast_ab(quick=args.quick)
+    elif args.transfer_only:
         transfer_benchmarks(quick=args.quick)
     else:
         main(quick=args.quick)

@@ -369,6 +369,12 @@ def cmd_stat(args):
     actors = info.get("actors", {})
     alive = sum(1 for a in actors.values() if a["state"] == "ALIVE")
     print(f"actors: {len(actors)} total, {alive} alive")
+    locs = info.get("object_locations") or {}
+    if locs.get("objects"):
+        print(f"object locations: {locs['objects']} objects replicated, "
+              f"{locs['replicas']} replicas")
+        for oid_hex, count in locs.get("top", []):
+            print(f"  {oid_hex[:16]:<18s} x{count}")
 
 
 def cmd_memory(args):
