@@ -251,6 +251,10 @@ class DeviceSebulbaSampler:
                     policy.params, self._stack, self._frames_d,
                     packed_d, policy._next_rng(), self.explore)
             self._frames_d = self._pending[5]
+            # Start the D2H action copy NOW: by the time sample() calls
+            # np.asarray the transfer has been overlapping env stepping
+            # and host bookkeeping instead of starting on demand.
+            self._pending[0].copy_to_host_async()
         else:
             frame = self._host_obs
             frame_d = jax.device_put(frame, policy._bsharded)
@@ -261,6 +265,7 @@ class DeviceSebulbaSampler:
                 self._pending = self._step_fn(
                     policy.params, self._stack, frame_d, done_d,
                     policy._next_rng(), self.explore)
+            self._pending[0].copy_to_host_async()
         if self.frame_stack:
             self._stack = self._pending[4]
 
