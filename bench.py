@@ -107,11 +107,18 @@ def median_windows(run_window, n: int = 3):
     return med, round(stddev_pct, 1), extra, [round(r, 1) for r in rates]
 
 
-def bench_kernel(n_dev: int):
+def bench_kernel(n_dev: int, curve_minibatches=(128, 512, 1024, 2048)):
     """Marginal learner-update throughput (SGD rows/s/chip), dispatch-
     and-readback overhead subtracted via two-point measurement; MFU from
     the scan-free update program's cost-analysis FLOPs (module doc).
-    Returns (rate, mfu_pct, train_flops_per_row, fwd_flops_per_row)."""
+
+    Also sweeps per-chip minibatch sizes into a batch-size->MFU curve
+    (the roofline companion, PERF.md round 8): per-row FLOPs are
+    constant, so MFU moves only with the achieved rows/s — the curve
+    shows where the update leaves the HBM-bound regime.
+
+    Returns (rate, mfu_pct, train_flops_per_row, fwd_flops_per_row,
+    curve)."""
     import jax
     from __graft_entry__ import _synthetic_ppo_batch
     from ray_tpu.parallel import mesh as mesh_lib
@@ -123,21 +130,21 @@ def bench_kernel(n_dev: int):
 
     num_actions = 6
     obs_shape = (84, 84, 4)
-    batch_size = 1024 * n_dev
-    minibatch = 256 * n_dev
+    num_mb = 4
 
     config = dict(DEFAULT_CONFIG)
     config.update({"_mesh": mesh})
     policy = PPOJaxPolicy(
         Box(low=0, high=255, shape=obs_shape, dtype=np.uint8),
         Discrete(num_actions), config)
+    rng = jax.random.PRNGKey(0)
+
+    # Per-row FLOPs from the scan-free programs (see module doc),
+    # measured once at the headline batch shape.
+    batch_size = 1024 * n_dev
     batch = _synthetic_ppo_batch(batch_size, obs_shape, num_actions,
                                  obs_dtype=np.uint8)
     dev_batch = policy._device_batch(batch)
-    rng = jax.random.PRNGKey(0)
-    num_mb = batch_size // minibatch
-
-    # Per-row FLOPs from the scan-free programs (see module doc).
     train_flops = compiled_flops(
         policy._train_fn,
         jax.tree.map(lambda x: x.copy(), policy.params),
@@ -148,32 +155,57 @@ def bench_kernel(n_dev: int):
     fwd_flops = compiled_flops(
         policy._action_fn, policy.params, obs_probe, rng, True)
     fwd_flops_per_row = fwd_flops / 256 if fwd_flops else 0.0
-
-    def timed(num_epochs: int, iters: int) -> float:
-        update = policy._make_sgd_fn(num_epochs, num_mb, minibatch)
-        params = jax.tree.map(lambda x: x.copy(), policy.params)
-        opt_state = jax.tree.map(lambda x: x.copy(), policy.opt_state)
-        for _ in range(3):
-            params, opt_state, stats = update(
-                params, opt_state, dev_batch, rng, policy.loss_state)
-        float(stats["total_loss"])  # sync
-        t0 = time.perf_counter()
-        for _ in range(iters):
-            params, opt_state, stats = update(
-                params, opt_state, dev_batch, rng, policy.loss_state)
-        float(stats["total_loss"])  # readback forces completion
-        return (time.perf_counter() - t0) / iters
-
-    e_lo, e_hi = 1, 16
-    t_lo = timed(e_lo, 10)
-    t_hi = timed(e_hi, 10)
-    marginal = max(1e-9, (t_hi - t_lo) / (e_hi - e_lo))
-    rate = batch_size / marginal / n_dev
-    mfu = None
     peak = chip_peak_flops()
+
+    def marginal_rate(mb_per_chip: int, iters: int = 10) -> float:
+        """Marginal fused-epoch rows/s/chip at num_mb minibatches of
+        mb_per_chip rows per chip (two-point epoch measurement)."""
+        minibatch = mb_per_chip * n_dev
+        bs = num_mb * minibatch
+        db = policy._device_batch(_synthetic_ppo_batch(
+            bs, obs_shape, num_actions, obs_dtype=np.uint8))
+
+        def timed(num_epochs: int) -> float:
+            update = policy._make_sgd_fn(num_epochs, num_mb, minibatch)
+            params = jax.tree.map(lambda x: x.copy(), policy.params)
+            opt_state = jax.tree.map(lambda x: x.copy(),
+                                     policy.opt_state)
+            for _ in range(3):
+                params, opt_state, stats = update(
+                    params, opt_state, db, rng, policy.loss_state)
+            float(stats["total_loss"])  # sync
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                params, opt_state, stats = update(
+                    params, opt_state, db, rng, policy.loss_state)
+            float(stats["total_loss"])  # readback forces completion
+            return (time.perf_counter() - t0) / iters
+
+        e_lo, e_hi = 1, 16
+        t_lo = timed(e_lo)
+        t_hi = timed(e_hi)
+        marginal = max(1e-9, (t_hi - t_lo) / (e_hi - e_lo))
+        return bs / marginal / n_dev
+
+    # Headline point: unchanged r4/r5 shape (4 x 256-row minibatches
+    # per chip) for round-over-round continuity.
+    rate = marginal_rate(256)
+    mfu = None
     if peak and train_flops_per_row:
         mfu = 100.0 * train_flops_per_row * rate / peak
-    return rate, mfu, train_flops_per_row, fwd_flops_per_row
+
+    curve = [{"minibatch_per_chip": 256,
+              "rows_per_s_per_chip": round(rate, 1),
+              "mfu_pct": round(mfu, 2) if mfu is not None else None}]
+    for mb in curve_minibatches:
+        r = marginal_rate(mb, iters=6)
+        curve.append({
+            "minibatch_per_chip": mb,
+            "rows_per_s_per_chip": round(r, 1),
+            "mfu_pct": (round(100.0 * train_flops_per_row * r / peak, 2)
+                        if peak and train_flops_per_row else None)})
+    curve.sort(key=lambda p: p["minibatch_per_chip"])
+    return rate, mfu, train_flops_per_row, fwd_flops_per_row, curve
 
 
 def bench_anakin(n_dev: int, flops_per_step: float = 0.0):
@@ -262,7 +294,8 @@ def measure_link_bandwidth_mbps() -> float:
 
 
 def bench_sebulba(n_dev: int, env: str, obs_delta, n_actors: int,
-                  n_envs: int, frag: int, windows: int = 3):
+                  n_envs: int, frag: int, windows: int = 3,
+                  env_groups: int = 2, onchip_steps: int = 1):
     """Host-env inline-actor IMPALA. CPU envs on this host feed
     device-resident rollouts; the learner trains in HBM. Returns
     (median steps/s/chip, stddev_pct, accounting dict)."""
@@ -280,6 +313,10 @@ def bench_sebulba(n_dev: int, env: str, obs_delta, n_actors: int,
         "device_frame_stack": 4,
         "obs_delta": obs_delta,
         "num_tpus_for_learner": n_dev,
+        # Pipeline gears (evaluation/device_sampler.py): double-buffered
+        # env groups + k-step on-device action selection.
+        "sebulba_env_groups": env_groups,
+        "sebulba_onchip_steps": onchip_steps,
         # Small queue bounds HBM: queued batches retain device-resident
         # obs columns (N*T x 84x84x4 uint8 each).
         "learner_queue_size": 2,
@@ -316,13 +353,26 @@ def bench_sebulba(n_dev: int, env: str, obs_delta, n_actors: int,
             "h2d_mbps": round(h2d / 1e6 / dt, 2),
             "bytes_per_step": round(h2d / max(1, sampled), 1),
             # Fetch/env times sum across actor threads, so the pcts can
-            # exceed 100 (overlapping threads are the design).
+            # exceed 100 (overlapping threads are the design). Per-actor
+            # fetch never exceeds wall-clock (asserted in tier-1,
+            # tests/test_sebulba_pipeline.py).
             "action_fetch_pct": round(
                 100 * (s1["t_fetch_s"] - s0["t_fetch_s"]) / dt, 1),
             "env_step_pct": round(
                 100 * (s1["t_env_s"] - s0["t_env_s"]) / dt, 1),
             "learner_busy_pct": round(
                 100 * (opt.learner.grad_timer.total - g0) / dt, 1),
+            # Pipeline-gear accounting: operating point, blocking
+            # fetches per sampled step (1/k when windows amortize the
+            # sync; /n_envs-per-group for the per-turn batch), and mean
+            # behavior-policy selection lag per transition.
+            "env_groups": env_groups,
+            "onchip_steps": onchip_steps,
+            "fetch_waits": s1.get("fetch_waits", 0)
+                           - s0.get("fetch_waits", 0),
+            "policy_lag_mean": round(
+                (s1.get("policy_lag_sum", 0)
+                 - s0.get("policy_lag_sum", 0)) / max(1, sampled), 3),
         }
         # Wire-codec view of the obs stream (sampled probe through the
         # runtime's StreamEncoder): what the striped data plane would
@@ -353,18 +403,64 @@ def bench_sebulba(n_dev: int, env: str, obs_delta, n_actors: int,
     return med, stddev_pct, acct
 
 
+SWEEP_POINTS = (
+    # (env_groups, onchip_steps): (1, 1) is the r05 serial pipeline —
+    # the control arm every other point is read against.
+    (1, 1),
+    (2, 1),
+    (4, 1),
+    (2, 5),
+    (4, 5),
+)
+
+
+def sweep_sebulba_points(n_dev: int, n_actors: int, n_envs: int,
+                         frag: int):
+    """Operating-point sweep over (env_groups, onchip_steps): one
+    10 s window per point on the headline env/config, same session
+    back-to-back (each point boots a fresh trainer). Returns
+    (points, best) where best maximizes steps/s/chip."""
+    points = []
+    for groups, k in SWEEP_POINTS:
+        if frag % k or n_envs % groups:
+            continue
+        rate, _, acct = bench_sebulba(
+            n_dev, env="SpriteAtari-v0", obs_delta="auto",
+            n_actors=n_actors, n_envs=n_envs, frag=frag, windows=1,
+            env_groups=groups, onchip_steps=k)
+        points.append({
+            "env_groups": groups,
+            "onchip_steps": k,
+            "steps_per_s_per_chip": round(rate, 1),
+            "action_fetch_pct": acct["action_fetch_pct"],
+            "env_step_pct": acct["env_step_pct"],
+            "learner_busy_pct": acct["learner_busy_pct"],
+            "policy_lag_mean": acct["policy_lag_mean"],
+            "link_util_pct": acct["link_util_pct"],
+        })
+    best = max(points, key=lambda p: p["steps_per_s_per_chip"])
+    return points, best
+
+
 def main():
     import jax
     n_dev = len(jax.devices())
-    kernel, kernel_mfu, train_fpr, fwd_fpr = bench_kernel(n_dev)
+    kernel, kernel_mfu, train_fpr, fwd_fpr, mfu_curve = bench_kernel(
+        n_dev)
     anakin, anakin_sd, reward, anakin_mfu, telemetry = bench_anakin(
         n_dev, flops_per_step=train_fpr + fwd_fpr)
-    # Headline host-env line: delta-encoded feeding on the
-    # Atari-statistics env (encoding + env disclosed below).
+    # Operating-point sweep (1 window each), then the full headline at
+    # the best point: delta-encoded feeding on the Atari-statistics env
+    # (encoding + env disclosed below).
+    sweep, best = sweep_sebulba_points(
+        n_dev, n_actors=12, n_envs=384, frag=25)
     sebulba, seb_sd, acct = bench_sebulba(
         n_dev, env="SpriteAtari-v0", obs_delta="auto",
-        n_actors=12, n_envs=384, frag=25)
-    # Continuity line: full frames on the incompressible r3/r4 env.
+        n_actors=12, n_envs=384, frag=25,
+        env_groups=best["env_groups"],
+        onchip_steps=best["onchip_steps"])
+    # Continuity line: full frames on the incompressible r3/r4 env
+    # (default gears: double-buffered groups, no on-chip windows).
     seb_full, seb_full_sd, acct_full = bench_sebulba(
         n_dev, env="SyntheticAtariFrames-v0", obs_delta=False,
         n_actors=4, n_envs=256, frag=25)
@@ -389,8 +485,14 @@ def main():
                             "retains frames, host ships changed pixels; "
                             "~1.8% pixels/step on this env (real ALE "
                             "frameskip-4: 2-13%)",
+            "env_groups": best["env_groups"],
+            "onchip_steps": best["onchip_steps"],
         },
         "sebulba_transfer_accounting": acct,
+        # Throughput-vs-gear curve, 1 window/point, same session
+        # back-to-back; (1,1) is the r05 serial pipeline control arm.
+        "sebulba_operating_points": sweep,
+        "sebulba_best_point": best,
         "sebulba_fullframe_per_chip": round(seb_full, 1),
         "sebulba_fullframe_vs_baseline": round(
             seb_full / BASELINE_PER_CHIP, 3),
@@ -404,6 +506,9 @@ def main():
         "kernel_per_chip": round(kernel, 1),
         "kernel_vs_baseline": round(kernel / BASELINE_PER_CHIP, 3),
         "kernel_note": "marginal fused-epoch rate w/ forced readback",
+        # Per-chip minibatch-size -> MFU curve (roofline companion,
+        # PERF.md round 8; per-row FLOPs constant across points).
+        "kernel_mfu_curve": mfu_curve,
         "cluster_metrics": telemetry,
     }
     if kernel_mfu is not None:

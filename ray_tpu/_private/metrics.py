@@ -33,6 +33,15 @@ sibling's wire transfer), `object_fetch_redirects_issued` /
 `object_fetch_redirects_followed` (owner fan-out cap), and
 `object_fetch_replica_fallbacks` (stale/dead replica -> owner); gauge
 `broadcast_fanout` (owner's peak concurrent uploads of one object).
+
+Sebulba pipeline series (inline-actor device rollouts,
+rllib/optimizers/async_samples_optimizer.py `InlineActorThread`):
+per-actor gauges `sebulba_action_fetch_pct.aK` (share of the actor's
+wall-clock blocked on the device action round-trip — the r5 wall this
+plane exists to watch), `sebulba_env_step_pct.aK` (host env stepping),
+and `sebulba_policy_lag_steps.aK` (mean behavior-policy selection lag
+per transition under `sebulba_onchip_steps` windows). Updated at
+sample-fragment boundaries; visible in `scripts stat --metrics`.
 """
 
 from __future__ import annotations

@@ -51,7 +51,11 @@ def make_async_optimizer(workers, config):
         device_rollouts=config.get("device_rollouts", "auto"),
         device_frame_stack=config.get("device_frame_stack", 0),
         obs_delta=config.get("obs_delta", "auto"),
-        obs_delta_budget=config.get("obs_delta_budget", 256))
+        obs_delta_budget=config.get("obs_delta_budget", 256),
+        # Sebulba pipeline gears (see evaluation/device_sampler.py):
+        # double-buffered env groups + k-step on-device selection.
+        sebulba_env_groups=config.get("sebulba_env_groups", 2),
+        sebulba_onchip_steps=config.get("sebulba_onchip_steps", 1))
 
 
 def validate_config(config):
@@ -71,6 +75,16 @@ def validate_config(config):
             raise ValueError(
                 "num_inline_actors is ignored in anakin mode — the "
                 "fused program does its own device-resident rollouts")
+        onchip = config.get("sebulba_onchip_steps", 1)
+        if onchip < 1:
+            raise ValueError("sebulba_onchip_steps must be >= 1")
+        if config["rollout_fragment_length"] % onchip:
+            raise ValueError(
+                "rollout_fragment_length must be a multiple of "
+                "sebulba_onchip_steps (fragments tile whole k-step "
+                "selection windows)")
+        if config.get("sebulba_env_groups", 1) < 1:
+            raise ValueError("sebulba_env_groups must be >= 1")
         # Inline actors own the real env batch; the local RolloutWorker
         # keeps a single probe env (spaces only).
         config["_inline_num_envs"] = config.get("num_envs_per_worker", 1)

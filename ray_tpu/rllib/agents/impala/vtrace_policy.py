@@ -66,6 +66,21 @@ DEFAULT_CONFIG = with_common_config({
     # Max changed pixels per env-row before falling back to a full-frame
     # row (generic DeltaEncoder only; native envs set their own budget).
     "obs_delta_budget": 256,
+    # Double-buffered env groups per inline actor (device rollouts
+    # only): while one group's inference + action fetch is in flight,
+    # the other groups' envs step on the host, hiding the device
+    # round-trip. Lag-0: trajectories are byte-identical to a single
+    # group. Falls back to the largest count that tiles the env slots
+    # and the learner mesh.
+    "sebulba_env_groups": 2,
+    # k-step on-device action selection (opt-in second gear): the
+    # select program samples k actions per device sync, amortizing the
+    # blocked round-trip by k at the price of up to k-1 steps of
+    # behavior-policy lag — recorded per transition (POLICY_LAG) and
+    # absorbed by V-trace since the stored behavior logits are the
+    # ones that actually selected each action. Requires
+    # rollout_fragment_length % k == 0.
+    "sebulba_onchip_steps": 1,
 })
 
 

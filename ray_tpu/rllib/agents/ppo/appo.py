@@ -44,6 +44,10 @@ DEFAULT_CONFIG = with_common_config({
     "learner_queue_size": 16,
     "num_sgd_iter": 1,
     "sgd_minibatch_size": 0,
+    # Sebulba pipeline gears (shared with IMPALA; see
+    # agents/impala/vtrace_policy.py for semantics).
+    "sebulba_env_groups": 2,
+    "sebulba_onchip_steps": 1,
 })
 
 

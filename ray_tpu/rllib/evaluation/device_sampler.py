@@ -9,19 +9,17 @@ Podracer/Sebulba answer (SURVEY.md §7.1; the reference's analogous
 staging layer is `rllib/optimizers/aso_multi_gpu_learner.py:140`
 `_LoaderThread`, which pre-loads tower buffers on the GPU):
 
-- One fused jitted step: upload newest frames -> (optional) on-device
-  frame-stack update -> model forward -> action sample. Only the action
-  array ([N] int32) is fetched back; logp/dist_inputs/values/obs stay
-  in HBM.
+- One device "apply" program per env step: upload newest frames ->
+  (optional) on-device frame-stack update -> the step's observation
+  batch, retained in HBM. One "select" program per WINDOW of k steps:
+  model forward at the newest observation -> k sampled action arrays,
+  fetched in a single [k, N] D2H copy (started async at dispatch).
 - Every per-step device observation is RETAINED; at fragment end the
   train batch's OBS / BOOTSTRAP_OBS / ACTION_DIST_INPUTS / ACTION_LOGP /
   VF_PREDS columns are assembled device-side (`jnp.stack`) and handed to
   the learner as jax arrays — `JaxPolicy._device_batch` passes them
   through without a host round-trip. Host->device traffic per timestep
   drops to one frame (k x smaller again under `DeviceFrameStack`).
-- Inference for step t+1 is dispatched BEFORE step t's host bookkeeping
-  (async JAX dispatch), so the upload/compute overlaps env stepping —
-  the double-buffering the r3 verdict asked for.
 - DELTA MODE (round 5; see `env/delta_obs.py`): when the env supports
   the delta protocol, the device retains the current frame batch in HBM
   and the host uploads only changed pixels ([N, K] uint16 indices +
@@ -31,9 +29,36 @@ staging layer is `rllib/optimizers/aso_multi_gpu_learner.py:140`
   15k steps/s/chip anchor requires of a multi-MB/s host->device link
   (VERDICT.md r4 next #1).
 
+Round 6 breaks the action-fetch wall (BENCH_r05: `action_fetch_pct`
+~387% — actors spent their wall-clock blocked in a synchronous
+device round-trip per env step while the link sat at 45%):
+
+- DOUBLE-BUFFERED ENV GROUPS (`sebulba_env_groups=G`): the actor's N
+  env slots split into G groups with independent frame stacks / delta
+  state / pending handles. While group B's inference + D2H fetch is in
+  flight, group A's envs step on the host — the device round-trip
+  hides behind the other groups' env stepping and dispatch work
+  instead of serializing with it. Pipeline algebra: a serial actor's
+  turn costs RTT + host_work; a grouped actor's turn costs
+  ~max(RTT, G*host_work/G) + epsilon because each group's fetch has
+  the other G-1 groups' host work in flight behind it. Groups hide
+  HOST time under DEVICE time; they cannot shrink the RTT itself.
+- K-STEP ON-DEVICE ACTION SELECTION (`sebulba_onchip_steps=k`, the
+  opt-in second gear): the select program's jitted scan samples k
+  action arrays against the retained device frames, so the host syncs
+  with the device once per k env steps — the blocked RTT is amortized
+  by k. The price is policy lag: the action for sub-step j of a window
+  was selected from the observation at the window head, j steps stale
+  (`POLICY_LAG` column records j per transition). The stored behavior
+  logits/logp are the ones that ACTUALLY selected each action, so
+  V-trace's importance ratios see the true behavior policy and absorb
+  the lag — exactly the off-policyness IMPALA's correction exists for
+  (PAPERS: "Podracer architectures for scalable RL").
+
 Byte/time accounting is kept on the instance (`bytes_h2d`, `bytes_d2h`,
-`t_fetch`, `t_env`) so `bench.py` can print a per-stage bandwidth
-account instead of asserting "transfer-bound" untested.
+`t_fetch`, `t_env`, `policy_lag_sum`, `fetch_waits`) so `bench.py` can
+print a per-stage bandwidth account instead of asserting
+"transfer-bound" untested.
 """
 
 from __future__ import annotations
@@ -50,49 +75,104 @@ from ..sample_batch import SampleBatch
 from .sampler import RolloutMetrics
 
 
+class _EnvGroup:
+    """One double-buffered slice of an inline actor's env slots.
+
+    Owns everything that must be independent for the group's device
+    pipeline to run while its siblings' fetches are in flight: the env,
+    frame stack, retained delta frames, episode bookkeeping, and the
+    pending (dispatched, unfetched) select-program outputs.
+    """
+
+    def __init__(self, sampler: "DeviceSebulbaSampler", env, eps_base: int):
+        self.env = env
+        n = env.num_envs
+        self.n = n
+        self.ep_rew = np.zeros(n, np.float64)
+        self.ep_len = np.zeros(n, np.int64)
+        self.cur_eps = eps_base + np.arange(n, dtype=np.int64)
+        self.host_done = np.ones(n, bool)
+        # Dispatched select outputs: (actions[k,n], logp[k,n], di, val).
+        self.pending = None
+        # Fetched window caches consumed sub-step by sub-step.
+        self.win_actions = None  # host [k, n]
+        self.win_logp = None     # device [k, n]
+        self.win_di = None       # device [n, A]
+        self.win_val = None      # device [n]
+        # Device obs for the NEXT transition (output of the last apply).
+        self.obs_next = None
+        policy = sampler.policy
+        if sampler.frame_stack:
+            space = env.observation_space
+            self.stack = jax.device_put(
+                np.zeros((n,) + space.shape, space.dtype),
+                policy._bsharded)
+        else:
+            self.stack = None
+        if sampler.delta:
+            ds = env.vector_reset_delta()
+            self.frames_d = jax.device_put(
+                np.ascontiguousarray(ds.full_frames), policy._bsharded)
+            sampler.bytes_h2d += ds.full_frames.nbytes
+            self.host_delta = None
+        else:
+            self.host_obs = np.asarray(env.vector_reset())
+
+
 class DeviceSebulbaSampler:
-    """Steps a BatchedEnv for T steps per sample(); obs live on device.
+    """Steps BatchedEnv groups for T steps per sample(); obs live on
+    device.
 
     Feedforward policies only (the LSTM path keeps host state threading;
     use `VectorSampler`). Output layout matches `VectorSampler`: flat
-    [N*T] rows, fragment-major, plus per-fragment BOOTSTRAP_OBS — except
-    the big columns are jax arrays already resident on the learner mesh.
+    [N*T] rows, fragment-major (group 0's envs first), plus per-fragment
+    BOOTSTRAP_OBS — except the big columns are jax arrays already
+    resident on the learner mesh.
+
+    `batched_env` may be a single BatchedEnv (one group — the serial
+    pipeline) or a list of same-sized BatchedEnvs (one per group).
     """
 
     def __init__(self, batched_env, policy,
                  rollout_fragment_length: int,
                  explore: bool = True,
                  eps_id_offset: int = 0,
-                 use_delta: bool = True):
+                 use_delta: bool = True,
+                 onchip_steps: int = 1):
         if getattr(policy, "recurrent", False):
             raise ValueError(
                 "DeviceSebulbaSampler supports feedforward policies only")
-        self.env = batched_env
+        envs: List = (list(batched_env)
+                      if isinstance(batched_env, (list, tuple))
+                      else [batched_env])
+        if len({e.num_envs for e in envs}) != 1:
+            raise ValueError(
+                "all env groups must have the same number of env slots; "
+                f"got {[e.num_envs for e in envs]}")
         self.policy = policy
         self.T = rollout_fragment_length
+        self.k = max(1, int(onchip_steps))
+        if self.T % self.k:
+            raise ValueError(
+                f"rollout_fragment_length ({self.T}) must be a multiple "
+                f"of sebulba_onchip_steps ({self.k}) — fragments tile "
+                "whole selection windows")
         self.explore = explore
         self.frame_stack = int(getattr(
-            batched_env, "device_frame_stack", 0))
+            envs[0], "device_frame_stack", 0))
         self.delta = bool(use_delta
-                          and hasattr(batched_env, "delta_budget"))
-        n = self.env.num_envs
-        self._n = n
-        self._ep_rew = np.zeros(n, np.float64)
-        self._ep_len = np.zeros(n, np.int64)
+                          and all(hasattr(e, "delta_budget") for e in envs))
+        self._n = sum(e.num_envs for e in envs)
         self._eps_counter = eps_id_offset
-        self._cur_eps = self._eps_counter + np.arange(n, dtype=np.int64)
-        self._eps_counter += n
         self.metrics: List[RolloutMetrics] = []
-        # Pending fused-step outputs for the CURRENT observation
-        # (dispatched by the previous loop turn / previous sample call).
-        self._pending = None
-        self._host_done = np.ones(n, bool)
         # ---- transfer accounting (read by bench.py) ------------------
         self.bytes_h2d = 0       # delta entries / frames + flags shipped
         self.bytes_d2h = 0       # action arrays fetched down
         self.t_fetch = 0.0       # host blocked waiting for actions
         self.t_env = 0.0         # host inside env.vector_step
         self.steps_total = 0
+        self.policy_lag_sum = 0  # sum over transitions of selection lag
+        self.fetch_waits = 0     # blocking D2H action fetches (windows)
         # Wire-codec probe: every Nth upload, a sample of the staged
         # obs buffer runs through the runtime's wire codec
         # (_private/serialization.StreamEncoder) to measure what the
@@ -104,65 +184,48 @@ class DeviceSebulbaSampler:
         self._wire_probe_every = 64
         self._wire_uploads = 0
 
-        if self.frame_stack:
-            space = self.env.observation_space
-            self._stack = jax.device_put(
-                np.zeros((n,) + space.shape, space.dtype),
-                policy._bsharded)
-        else:
-            self._stack = None
-
         if self.delta:
-            frame_space = getattr(self.env, "inner", self.env)\
+            frame_space = getattr(envs[0], "inner", envs[0])\
                 .observation_space
             fs = frame_space.shape
             self._frame_shape = fs
             self._hw = int(np.prod(fs))
             self._full_fns = {}
-            ds = self.env.vector_reset_delta()
-            self._frames_d = jax.device_put(
-                np.ascontiguousarray(ds.full_frames), policy._bsharded)
-            self.bytes_h2d += ds.full_frames.nbytes
-            self._host_delta = None
-        else:
-            self._host_obs = np.asarray(self.env.vector_reset())
+
+        self.groups: List[_EnvGroup] = []
+        for env in envs:
+            self.groups.append(
+                _EnvGroup(self, env, self._eps_counter))
+            self._eps_counter += env.num_envs
         self._build_fns()
+        # Prime every group's pipeline: obs_0 onto the device, first
+        # selection window dispatched.
+        for g in self.groups:
+            self._dispatch_apply(g)
+            self._dispatch_select(g)
 
     # ------------------------------------------------------------------
     def _build_fns(self):
         policy = self.policy
         S = self.frame_stack
+        k = self.k
 
-        def stack_and_infer(params, stack, frame, done, rng, explore):
-            """frame: [N, H, W, C] newest observation. Returns the fused
-            (actions, logp, dist_inputs, value, obs)."""
-            if S:
-                # Episode boundary: the stack restarts filled with the
-                # new episode's first frame (host FrameStack semantics,
-                # reference `atari_wrappers.py` FrameStack.reset).
-                filled = jnp.broadcast_to(frame, stack.shape).astype(
-                    stack.dtype)
-                rolled = jnp.concatenate(
-                    [stack[..., 1:], frame.astype(stack.dtype)], axis=-1)
-                obs = jnp.where(
-                    done[:, None, None, None], filled, rolled)
-            else:
-                obs = frame
-            dist_inputs, value = policy.apply(params, obs)
-            dist = policy.dist_class(dist_inputs)
-            actions = jax.lax.cond(
-                explore,
-                lambda: dist.sample(rng),
-                lambda: dist.deterministic_sample())
-            logp = dist.logp(actions)
-            return actions, logp, dist_inputs, value, obs
+        def update_stack(stack, frame, done):
+            """Newest frame into the rolling [*, S] stack; episode
+            boundary restarts the stack filled with the new episode's
+            first frame (host FrameStack semantics, reference
+            `atari_wrappers.py` FrameStack.reset)."""
+            filled = jnp.broadcast_to(frame, stack.shape).astype(
+                stack.dtype)
+            rolled = jnp.concatenate(
+                [stack[..., 1:], frame.astype(stack.dtype)], axis=-1)
+            return jnp.where(done[:, None, None, None], filled, rolled)
 
         if self.delta:
             shape = self._frame_shape
-            K = int(self.env.delta_budget)
+            K = int(self.groups[0].env.delta_budget)
 
-            def delta_step_fn(params, stack, frames, packed, rng,
-                              explore):
+            def apply_delta(stack, frames, packed):
                 # frames: [N, HW] uint8 retained on device. packed:
                 # [N, 3K+1] uint8 — ONE upload per step carrying the
                 # sparse delta and done flags (layout in _pack_step:
@@ -177,27 +240,60 @@ class DeviceSebulbaSampler:
                     jnp.arange(n)[:, None], idx.astype(jnp.int32)].set(
                         val, mode="drop")
                 frame = frames.reshape((n,) + shape)
-                out = stack_and_infer(
-                    params, stack, frame, done, rng, explore)
-                return out + (frames,)
+                obs = update_stack(stack, frame, done) if S else frame
+                return obs, frames
 
-            # frames (arg 2) is donated: the old frame buffer is dead
-            # once the new one exists; saves an HBM copy per step.
-            self._step_fn = jax.jit(delta_step_fn, donate_argnums=(2,))
+            # frames (arg 1) is donated: the old frame buffer is dead
+            # once the new one exists; saves an HBM copy per step. The
+            # stack is NOT donated — it aliases the previous step's obs,
+            # which the train batch retains.
+            self._apply_fn = jax.jit(apply_delta, donate_argnums=(1,))
         else:
-            self._step_fn = jax.jit(stack_and_infer)
+            def apply_frame(stack, frame, done):
+                return update_stack(stack, frame, done) if S else frame
+
+            self._apply_fn = jax.jit(apply_frame)
+
+        def select_fn(params, obs, rng, explore):
+            """Model forward at the newest obs, then k sampled action
+            arrays. All k actions of a window are selected from THIS
+            observation's distribution — sub-step j executes with lag j,
+            and these dist_inputs/logp are the true behavior policy that
+            V-trace corrects against."""
+            dist_inputs, value = policy.apply(params, obs)
+            dist = policy.dist_class(dist_inputs)
+            if k == 1:
+                actions = jax.lax.cond(
+                    explore,
+                    lambda: dist.sample(rng),
+                    lambda: dist.deterministic_sample())
+                logp = dist.logp(actions)
+                return actions[None], logp[None], dist_inputs, value
+
+            def pick(carry, key):
+                a = jax.lax.cond(
+                    explore,
+                    lambda: dist.sample(key),
+                    lambda: dist.deterministic_sample())
+                return carry, (a, dist.logp(a))
+
+            _, (actions, logp) = jax.lax.scan(
+                pick, 0, jax.random.split(rng, k))
+            return actions, logp, dist_inputs, value
+
+        self._select_fn = jax.jit(select_fn)
 
     def _pack_step(self, idx: np.ndarray, val: np.ndarray,
                    done: np.ndarray) -> np.ndarray:
         """One contiguous uint8 buffer per step (layout read back by
-        `delta_step_fn`): [idx as LE uint16 bytes | val | done]."""
+        `apply_delta`): [idx as LE uint16 bytes | val | done]."""
         assert idx.dtype == np.uint16
         return np.concatenate(
             [np.ascontiguousarray(idx).view(np.uint8),
              val, done.astype(np.uint8)[:, None]], axis=1)
 
     def _full_fn(self, b: int):
-        """Bucketed whole-row replacement: rows [b] int32 (pad == N,
+        """Bucketed whole-row replacement: rows [b] int32 (pad == n,
         dropped), fulls [b, HW] uint8."""
         if b not in self._full_fns:
             def apply_full(frames, rows, fulls):
@@ -206,30 +302,30 @@ class DeviceSebulbaSampler:
                 apply_full, donate_argnums=(0,))
         return self._full_fns[b]
 
-    def _dispatch_step(self):
-        """Upload the newest env output and dispatch fused inference.
-
-        Returns immediately (async JAX dispatch); the result is consumed
-        by the next loop turn, overlapping transfer+compute with the
-        host-side env step and bookkeeping.
+    # ------------------------------------------------------------------
+    def _dispatch_apply(self, g: _EnvGroup):
+        """Upload the group's newest env output and dispatch the obs
+        apply (delta scatter / frame-stack update). Returns immediately
+        (async JAX dispatch); `g.obs_next` is the device handle for the
+        next transition's observation.
         """
         policy = self.policy
-        done = self._host_done
+        done = g.host_done
         if self.delta:
-            ds = self._host_delta
+            ds = g.host_delta
             if ds is not None and len(ds.full_rows):
                 # Resets / over-budget rows: bucketed full-row scatter
                 # ahead of the sparse delta (delta entries for these
                 # rows are pad, per the DeltaStep contract).
                 b = 1 << (int(len(ds.full_rows)) - 1).bit_length() \
                     if len(ds.full_rows) > 1 else 1
-                b = min(b, self._n)
-                rows = np.full(b, self._n, np.int32)
+                b = min(b, g.n)
+                rows = np.full(b, g.n, np.int32)
                 rows[:len(ds.full_rows)] = ds.full_rows
                 fulls = np.zeros((b, self._hw), np.uint8)
                 fulls[:len(ds.full_rows)] = ds.full_frames
-                self._frames_d = self._full_fn(b)(
-                    self._frames_d,
+                g.frames_d = self._full_fn(b)(
+                    g.frames_d,
                     jax.device_put(rows, policy._repl),
                     jax.device_put(fulls, policy._repl))
                 self.bytes_h2d += rows.nbytes + fulls.nbytes
@@ -238,7 +334,7 @@ class DeviceSebulbaSampler:
                 # an all-pad delta leaves them untouched.
                 from ..env.delta_obs import all_pad_delta
                 pad = all_pad_delta(
-                    self._n, int(self.env.delta_budget), self._hw)
+                    g.n, int(g.env.delta_budget), self._hw)
                 idx, val = pad.idx, pad.val
             else:
                 idx, val = ds.idx, ds.val
@@ -246,100 +342,139 @@ class DeviceSebulbaSampler:
             packed_d = jax.device_put(packed, policy._bsharded)
             self.bytes_h2d += packed.nbytes
             self._wire_probe(packed)
-            with policy._update_lock:
-                self._pending = self._step_fn(
-                    policy.params, self._stack, self._frames_d,
-                    packed_d, policy._next_rng(), self.explore)
-            self._frames_d = self._pending[5]
-            # Start the D2H action copy NOW: by the time sample() calls
-            # np.asarray the transfer has been overlapping env stepping
-            # and host bookkeeping instead of starting on demand.
-            self._pending[0].copy_to_host_async()
+            g.obs_next, g.frames_d = self._apply_fn(
+                g.stack, g.frames_d, packed_d)
         else:
-            frame = self._host_obs
+            frame = g.host_obs
             frame_d = jax.device_put(frame, policy._bsharded)
             done_d = jax.device_put(done, policy._bsharded)
             self.bytes_h2d += frame.nbytes + done.nbytes
             self._wire_probe(frame)
-            with policy._update_lock:
-                self._pending = self._step_fn(
-                    policy.params, self._stack, frame_d, done_d,
-                    policy._next_rng(), self.explore)
-            self._pending[0].copy_to_host_async()
+            g.obs_next = self._apply_fn(g.stack, frame_d, done_d)
         if self.frame_stack:
-            self._stack = self._pending[4]
+            g.stack = g.obs_next
+
+    def _dispatch_select(self, g: _EnvGroup):
+        """Dispatch the selection window for the group's newest obs and
+        start the D2H action copy so the eventual fetch is a cache hit.
+        Reads live params — serialized against learner updates."""
+        policy = self.policy
+        with policy._update_lock:
+            out = self._select_fn(
+                policy.params, g.obs_next, policy._next_rng(),
+                self.explore)
+        out[0].copy_to_host_async()
+        g.pending = out
+
+    def _consume_window(self, g: _EnvGroup):
+        """Block on the group's dispatched selection window — the ONLY
+        device fetch on the hot path, one [k, n] array per k steps."""
+        acts_d, logp_d, di_d, val_d = g.pending
+        g.pending = None
+        t0 = time.perf_counter()
+        g.win_actions = np.asarray(acts_d)
+        self.t_fetch += time.perf_counter() - t0
+        self.fetch_waits += 1
+        self.bytes_d2h += g.win_actions.nbytes
+        g.win_logp, g.win_di, g.win_val = logp_d, di_d, val_d
 
     # ------------------------------------------------------------------
     def sample(self) -> SampleBatch:
-        N, T = self._n, self.T
-        obs_buf, logp_buf, di_buf, vf_buf = [], [], [], []
-        act_host, rew_buf, done_buf = [], [], []
-        eps_ids = np.empty((T, N), np.int64)
-        ts = np.empty((T, N), np.int64)
+        T, k = self.T, self.k
+        G = len(self.groups)
+        obs_buf = [[] for _ in range(G)]
+        logp_buf = [[] for _ in range(G)]
+        di_buf = [[] for _ in range(G)]
+        vf_buf = [[] for _ in range(G)]
+        act_host = [[] for _ in range(G)]
+        rew_buf = [[] for _ in range(G)]
+        done_buf = [[] for _ in range(G)]
+        eps_ids = [np.empty((T, g.n), np.int64) for g in self.groups]
+        ts = [np.empty((T, g.n), np.int64) for g in self.groups]
 
         for t in range(T):
-            if self._pending is None:
-                self._dispatch_step()
-            pend = self._pending
-            acts_d, logp_d, di_d, val_d, obs_d = pend[:5]
-            self._pending = None
-            obs_buf.append(obs_d)
-            logp_buf.append(logp_d)
-            di_buf.append(di_d)
-            vf_buf.append(val_d)
-            t0 = time.perf_counter()
-            actions = np.asarray(acts_d)  # the ONLY device fetch
-            self.t_fetch += time.perf_counter() - t0
-            self.bytes_d2h += actions.nbytes
-            t0 = time.perf_counter()
-            if self.delta:
-                self._host_delta, rewards, dones = \
-                    self.env.vector_step_delta(actions)
-            else:
-                next_obs, rewards, dones = self.env.vector_step(actions)
-                self._host_obs = np.asarray(next_obs)
-            self.t_env += time.perf_counter() - t0
-            eps_ids[t] = self._cur_eps
-            ts[t] = self._ep_len
-            act_host.append(actions)
-            rew_buf.append(np.asarray(rewards, np.float32))
-            done_buf.append(np.asarray(dones))
-            self._ep_rew += rewards
-            self._ep_len += 1
-            if dones.any():
-                done_idx = np.nonzero(dones)[0]
-                for i in done_idx:
-                    self.metrics.append(RolloutMetrics(
-                        int(self._ep_len[i]), float(self._ep_rew[i])))
-                self._ep_rew[dones] = 0.0
-                self._ep_len[dones] = 0
-                self._cur_eps[dones] = self._eps_counter + np.arange(
-                    len(done_idx), dtype=np.int64)
-                self._eps_counter += len(done_idx)
-            self._host_done = np.asarray(dones)
-            # Per-turn accounting (not per-fragment): the bench's
-            # windowed bytes-per-step ratio needs finer ticks than
-            # fragment completions on LOW-rate configs — the full-frame
-            # continuity line completes only ~2-3 fragments per 10s
-            # window, quantizing the ratio by 2-3x. Total per fragment
-            # is unchanged (T ticks of N == N*T).
-            self.steps_total += N
-            # Prefetch: inference for the NEXT obs runs while this turn
-            # finishes bookkeeping (and while the learner trains).
-            self._dispatch_step()
+            jw = t % k
+            for gi, g in enumerate(self.groups):
+                if jw == 0:
+                    # While this fetch blocks, every OTHER group's
+                    # apply/select programs keep running on device —
+                    # the double-buffering that hides the round-trip.
+                    self._consume_window(g)
+                obs_buf[gi].append(g.obs_next)
+                logp_buf[gi].append(g.win_logp[jw])
+                di_buf[gi].append(g.win_di)
+                vf_buf[gi].append(g.win_val)
+                actions = g.win_actions[jw]
+                t0 = time.perf_counter()
+                if self.delta:
+                    g.host_delta, rewards, dones = \
+                        g.env.vector_step_delta(actions)
+                else:
+                    next_obs, rewards, dones = g.env.vector_step(actions)
+                    g.host_obs = np.asarray(next_obs)
+                self.t_env += time.perf_counter() - t0
+                eps_ids[gi][t] = g.cur_eps
+                ts[gi][t] = g.ep_len
+                act_host[gi].append(actions)
+                rew_buf[gi].append(np.asarray(rewards, np.float32))
+                done_buf[gi].append(np.asarray(dones))
+                g.ep_rew += rewards
+                g.ep_len += 1
+                if dones.any():
+                    done_idx = np.nonzero(dones)[0]
+                    for i in done_idx:
+                        self.metrics.append(RolloutMetrics(
+                            int(g.ep_len[i]), float(g.ep_rew[i])))
+                    g.ep_rew[dones] = 0.0
+                    g.ep_len[dones] = 0
+                    g.cur_eps[dones] = self._eps_counter + np.arange(
+                        len(done_idx), dtype=np.int64)
+                    self._eps_counter += len(done_idx)
+                g.host_done = np.asarray(dones)
+                # Per-turn accounting (not per-fragment): the bench's
+                # windowed bytes-per-step ratio needs finer ticks than
+                # fragment completions on LOW-rate configs — the
+                # full-frame continuity line completes only ~2-3
+                # fragments per 10s window, quantizing the ratio by
+                # 2-3x. Total per fragment is unchanged.
+                self.steps_total += g.n
+                # Prefetch: the obs apply for the NEXT step runs while
+                # this turn finishes bookkeeping (and while the learner
+                # trains); at window end the next selection dispatches.
+                self._dispatch_apply(g)
+                if jw == k - 1:
+                    self._dispatch_select(g)
 
-        # The pending step's obs is the post-fragment bootstrap
+        # Selection lag per transition: sub-step j of a window executed
+        # an action chosen from the window-head obs, j steps stale.
+        lags = (np.arange(T, dtype=np.int64) % k).astype(np.int32)
+        self.policy_lag_sum += int(lags.sum()) * self._n
+
+        # Each group's obs_next is the post-fragment bootstrap
         # observation AND step 0 of the next fragment — computed once.
-        boot_obs = self._pending[4]
+        boot_obs = (self.groups[0].obs_next if G == 1 else
+                    jnp.concatenate(
+                        [g.obs_next for g in self.groups], axis=0))
 
-        def dpack(bufs):
-            a = jnp.stack(bufs)  # [T, N, ...]
-            return jnp.swapaxes(a, 0, 1).reshape(
-                (N * T,) + a.shape[2:])
+        def dpack(gbufs):
+            parts = []
+            for g, bufs in zip(self.groups, gbufs):
+                a = jnp.stack(bufs)  # [T, n, ...]
+                parts.append(jnp.swapaxes(a, 0, 1).reshape(
+                    (g.n * T,) + a.shape[2:]))
+            return parts[0] if G == 1 else jnp.concatenate(parts, axis=0)
 
-        def hpack(bufs):
-            a = np.stack(bufs)
-            return np.swapaxes(a, 0, 1).reshape((N * T,) + a.shape[2:])
+        def hpack(gbufs):
+            parts = []
+            for g, bufs in zip(self.groups, gbufs):
+                a = np.stack(bufs)
+                parts.append(np.swapaxes(a, 0, 1).reshape(
+                    (g.n * T,) + a.shape[2:]))
+            return parts[0] if G == 1 else np.concatenate(parts, axis=0)
+
+        def hpack_tn(arrs):
+            return np.concatenate(
+                [np.swapaxes(a, 0, 1).reshape(-1) for a in arrs])
 
         return SampleBatch({
             sb.OBS: dpack(obs_buf),
@@ -350,8 +485,9 @@ class DeviceSebulbaSampler:
             sb.ACTIONS: hpack(act_host),
             sb.REWARDS: hpack(rew_buf),
             sb.DONES: hpack(done_buf),
-            sb.EPS_ID: np.swapaxes(eps_ids, 0, 1).reshape(-1),
-            sb.T: np.swapaxes(ts, 0, 1).reshape(-1),
+            sb.EPS_ID: hpack_tn(eps_ids),
+            sb.T: hpack_tn(ts),
+            sb.POLICY_LAG: np.tile(lags, self._n),
         })
 
     def get_metrics(self) -> List[RolloutMetrics]:
@@ -377,6 +513,8 @@ class DeviceSebulbaSampler:
             "t_fetch_s": round(self.t_fetch, 3),
             "t_env_s": round(self.t_env, 3),
             "steps": self.steps_total,
+            "policy_lag_sum": self.policy_lag_sum,
+            "fetch_waits": self.fetch_waits,
             "wire_probe_raw": self.wire_probe_raw,
             "wire_probe_wire": self.wire_probe_wire,
         }

@@ -110,19 +110,23 @@ class InlineActorThread(threading.Thread):
     live params), one jitted inference call per step for all env slots.
     """
 
-    def __init__(self, sampler, learner: LearnerThread):
-        super().__init__(daemon=True, name="inline-actor")
+    def __init__(self, sampler, learner: LearnerThread, idx: int = 0):
+        super().__init__(daemon=True, name=f"inline-actor-{idx}")
         self.sampler = sampler
         self.learner = learner
+        self.idx = idx
         self.stopped = False
         self.error = None  # first exception that killed the thread
         self.steps_sampled = 0  # monotonic; read without lock (int swap)
+        self._gauge_last = None
+        self._gauge_t0 = time.perf_counter()
 
     def run(self):
         try:
             while not self.stopped:
                 batch = self.sampler.sample()
                 self.steps_sampled += batch.count
+                self._publish_pipeline_gauges()
                 while not self.stopped:
                     try:
                         self.learner.inqueue.put(batch, timeout=1.0)
@@ -133,6 +137,38 @@ class InlineActorThread(threading.Thread):
             logger.exception("inline actor died")
             self.error = e
             self.stopped = True
+
+    def _publish_pipeline_gauges(self):
+        """Per-actor pipeline balance into the metrics plane (visible in
+        `scripts stat --metrics` / Prometheus), so a pipeline regression
+        shows up live instead of only inside a 10 s bench window:
+        `sebulba_action_fetch_pct.aK` (host blocked on the device
+        round-trip), `sebulba_env_step_pct.aK`, and
+        `sebulba_policy_lag_steps.aK` (mean selection lag)."""
+        if not hasattr(self.sampler, "transfer_stats"):
+            return  # host-side VectorSampler: no device pipeline
+        now = time.perf_counter()
+        dt = now - self._gauge_t0
+        stats = self.sampler.transfer_stats()
+        if self._gauge_last is not None and dt >= 0.5:
+            last = self._gauge_last
+            from ..._private import metrics as metrics_mod
+            tag = f"a{self.idx}"
+            metrics_mod.set_gauge(
+                f"sebulba_action_fetch_pct.{tag}",
+                100.0 * (stats["t_fetch_s"] - last["t_fetch_s"]) / dt)
+            metrics_mod.set_gauge(
+                f"sebulba_env_step_pct.{tag}",
+                100.0 * (stats["t_env_s"] - last["t_env_s"]) / dt)
+            dsteps = stats["steps"] - last["steps"]
+            if dsteps > 0:
+                metrics_mod.set_gauge(
+                    f"sebulba_policy_lag_steps.{tag}",
+                    (stats.get("policy_lag_sum", 0)
+                     - last.get("policy_lag_sum", 0)) / dsteps)
+        if self._gauge_last is None or dt >= 0.5:
+            self._gauge_last = stats
+            self._gauge_t0 = now
 
     def stop(self):
         self.stopped = True
@@ -158,7 +194,9 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                  device_rollouts: str = "auto",
                  device_frame_stack: int = 0,
                  obs_delta="auto",
-                 obs_delta_budget: int = 256):
+                 obs_delta_budget: int = 256,
+                 sebulba_env_groups: int = 1,
+                 sebulba_onchip_steps: int = 1):
         super().__init__(workers)
         self.train_batch_size = train_batch_size
         self.rollout_fragment_length = rollout_fragment_length
@@ -209,25 +247,59 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                 raise ValueError(
                     "device_frame_stack requires device rollouts "
                     "(feedforward policy + device_rollouts auto/True)")
-            for k in range(num_inline_actors):
-                benv = make_batched_env(
-                    inline_env, inline_num_envs, inline_env_config,
-                    seed=None if inline_seed is None
-                    else inline_seed + 1000 * (k + 1),
-                    device_frame_stack=device_frame_stack,
-                    obs_delta=obs_delta if use_device else False,
-                    obs_delta_budget=obs_delta_budget)
+            onchip = max(1, int(sebulba_onchip_steps))
+            if onchip > 1 and not use_device:
+                raise ValueError(
+                    "sebulba_onchip_steps > 1 requires device rollouts "
+                    "(feedforward policy + device_rollouts auto/True) — "
+                    "the host-side VectorSampler has no retained device "
+                    "frames to select against")
+            # Double-buffered env groups (device path only): the largest
+            # group count <= requested that tiles both the env slots and
+            # the mesh; host-path samplers have no device pipeline to
+            # double-buffer, so they always run one group.
+            groups = max(1, int(sebulba_env_groups)) if use_device else 1
+            while groups > 1 and (
+                    inline_num_envs % groups
+                    or (inline_num_envs // groups) % max(1, mesh_size)):
+                groups -= 1
+            if use_device and groups != max(1, int(sebulba_env_groups)):
+                logger.info(
+                    "sebulba_env_groups=%s does not tile %d envs over a "
+                    "%d-device mesh; running %d group(s)",
+                    sebulba_env_groups, inline_num_envs, mesh_size,
+                    groups)
+            for ai in range(num_inline_actors):
+                def _seed(gi):
+                    if inline_seed is None:
+                        return None
+                    return inline_seed + 1000 * (ai + 1) + 131 * gi
                 if use_device:
+                    envs = [make_batched_env(
+                        inline_env, inline_num_envs // groups,
+                        inline_env_config, seed=_seed(gi),
+                        device_frame_stack=device_frame_stack,
+                        obs_delta=obs_delta,
+                        obs_delta_budget=obs_delta_budget)
+                        for gi in range(groups)]
                     sampler = DeviceSebulbaSampler(
-                        benv, policy, rollout_fragment_length,
-                        eps_id_offset=(k + 1) << 40,
-                        use_delta=obs_delta is not False)
+                        envs if groups > 1 else envs[0], policy,
+                        rollout_fragment_length,
+                        eps_id_offset=(ai + 1) << 40,
+                        use_delta=obs_delta is not False,
+                        onchip_steps=onchip)
                 else:
+                    benv = make_batched_env(
+                        inline_env, inline_num_envs, inline_env_config,
+                        seed=_seed(0),
+                        device_frame_stack=device_frame_stack,
+                        obs_delta=False,
+                        obs_delta_budget=obs_delta_budget)
                     sampler = VectorSampler(
                         benv, policy, rollout_fragment_length,
-                        eps_id_offset=(k + 1) << 40)
+                        eps_id_offset=(ai + 1) << 40)
                 self._inline_actors.append(
-                    InlineActorThread(sampler, self.learner))
+                    InlineActorThread(sampler, self.learner, idx=ai))
             for a in self._inline_actors:
                 a.start()
 

@@ -38,6 +38,13 @@ STATE_OUT = "state_out"
 # row per rollout fragment rather than per step (emitted by the packed
 # VectorSampler so the learner never ships a full NEW_OBS column).
 BOOTSTRAP_OBS = "bootstrap_obs"
+# Behavior-policy selection lag in env steps, [num_rows] int32: how
+# stale the observation that selected this row's action was (0 for
+# synchronous sampling; j for sub-step j of a `sebulba_onchip_steps`
+# window). The stored ACTION_DIST_INPUTS/ACTION_LOGP are always the
+# distribution that actually selected the action, so V-trace ratios
+# stay exact; this column only records the lag for accounting.
+POLICY_LAG = "policy_lag"
 
 # Columns whose leading dimension is NOT the per-step row count.
 _NON_ROW_COLUMNS = (SEQ_LENS, BOOTSTRAP_OBS)

@@ -222,7 +222,7 @@ class TestDeviceSamplerDelta:
         assert obs.shape == (N * T, 84, 84, K)
         # Newest channel of step t equals the canonical frame trail:
         # reconstructed device frames match the env's canonical state.
-        frames_dev = np.asarray(sampler._frames_d)
+        frames_dev = np.asarray(sampler.groups[0].frames_d)
         np.testing.assert_array_equal(
             frames_dev, env.inner._frames[:, :-1])
 
