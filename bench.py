@@ -277,6 +277,51 @@ def snapshot_cluster_metrics():
         return None
 
 
+def bench_weight_sync(syncs: int = 6):
+    """Per-update weight-sync cost on the flagship Nature-CNN tree:
+    bytes/sync for the full-blob codec vs the q8_delta plane (and the
+    4-way sharded variant), measured at the encoder (what one worker
+    receives per broadcast). Rides into BENCH json so the trajectory
+    tracks sync cost from r06 onward."""
+    import jax
+
+    from ray_tpu._private.weight_sync import WeightSyncEncoder
+    from ray_tpu.models.networks import VisionNetwork
+
+    model = VisionNetwork(num_outputs=6)
+    weights = jax.tree.map(
+        np.asarray, model.init(
+            jax.random.PRNGKey(0), np.zeros((1, 84, 84, 4), np.uint8)))
+    blob = sum(np.asarray(l).nbytes for l in jax.tree.leaves(weights))
+    rng = np.random.default_rng(2)
+    out = {"blob_bytes": int(blob)}
+    for arm, (codec, shards) in {
+            "full": ("full", 1),
+            "q8_delta": ("q8_delta", 1),
+            "q8_delta_s4": ("q8_delta", 4)}.items():
+        enc = WeightSyncEncoder(codec=codec, shard_count=shards)
+        w = weights
+        sizes, times = [], []
+        for i in range(syncs + 1):
+            t0 = time.perf_counter()
+            payloads = enc.encode(w)
+            dt = time.perf_counter() - t0
+            if i > 0:  # sync 0 establishes the base (always full)
+                sizes.append(sum(p.nbytes for p in payloads))
+                times.append(dt)
+            w = jax.tree.map(
+                lambda x: x + (5e-4 * rng.standard_normal(
+                    x.shape)).astype(x.dtype), w)
+        sizes.sort(), times.sort()
+        out[f"{arm}_bytes_per_update"] = int(sizes[len(sizes) // 2])
+        out[f"{arm}_encode_ms"] = round(
+            1e3 * times[len(times) // 2], 2)
+    out["wire_ratio_vs_full"] = round(
+        out["full_bytes_per_update"]
+        / max(1, out["q8_delta_bytes_per_update"]), 2)
+    return out
+
+
 def measure_link_bandwidth_mbps() -> float:
     """Raw host->device link rate: timed device_put of a 32 MiB buffer
     (median of 5), with a readback touch to force completion."""
@@ -388,6 +433,17 @@ def bench_sebulba(n_dev: int, env: str, obs_delta, n_actors: int,
         return trained / dt / n_dev, acct
 
     med, stddev_pct, acct, rates = median_windows(window, windows)
+    # Weight-sync accounting (r06+): wire bytes per learner update and
+    # broadcast cadence. Inline (Sebulba) actors read the live params —
+    # zero broadcast bytes by design — so this records the architecture
+    # dividend, and goes nonzero on remote-worker runs.
+    snap = snapshot_cluster_metrics() or {"counters": {}}
+    updates = max(1, opt.num_steps_trained // max(1, n_envs * frag))
+    acct["weight_sync_bytes_per_update"] = round(
+        snap["counters"].get("weight_sync_bytes", 0) / updates, 1)
+    acct["weight_broadcasts_per_update"] = round(
+        opt.num_weight_broadcasts / updates, 3)
+    acct["weight_sync_codec"] = opt._broadcaster.encoder.codec
     reward = (last_result[0] or {}).get("episode_reward_mean")
     # NaN -> None keeps the JSON machine-readable.
     acct["episode_reward_mean"] = (
@@ -509,6 +565,10 @@ def main():
         # Per-chip minibatch-size -> MFU curve (roofline companion,
         # PERF.md round 8; per-row FLOPs constant across points).
         "kernel_mfu_curve": mfu_curve,
+        # Encoder-level weight-sync cost on the flagship tree (bytes a
+        # worker receives per broadcast, per codec arm) — the delta
+        # plane's r06+ trajectory line.
+        "weight_sync": bench_weight_sync(),
         "cluster_metrics": telemetry,
     }
     if kernel_mfu is not None:

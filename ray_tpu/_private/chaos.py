@@ -81,6 +81,15 @@ SITES: Dict[str, Dict[str, str]] = {
         "stale": "the replica no longer holds the object (stale "
                  "directory entry; fetch falls back to the owner)",
     },
+    "weights.sync": {
+        "drop": "the sender records a weight sync as delivered but never "
+                "ships it (the worker's base version silently falls "
+                "behind; the next delta triggers the stale-base "
+                "handshake and a full-sync fallback)",
+        "stale": "the receiver's held base vanishes right before a "
+                 "delta applies (restarted worker / evicted base; "
+                 "decode reports stale and the sender full-syncs)",
+    },
     "exec.before": {
         "kill": "kill the worker process before the task body runs",
     },

@@ -75,6 +75,24 @@ _def("RAY_TPU_GET_PREFETCH", int, 8,
      "Parallel fetch window for multi-ref get()/wait(): pending "
      "foreign refs are requested concurrently up to this many at once")
 
+# --- weight-sync delta plane (_private/weight_sync.py) ----------------
+_def("RAY_TPU_WEIGHT_CODEC", str, "q8_delta",
+     "Weight broadcast codec when trainers leave weight_sync_codec="
+     "'auto': full (ship the whole float32 tree every sync) | q8_delta "
+     "(int8 block-quantized deltas with sender-side error feedback; "
+     "receivers with a stale/missing base transparently get a full "
+     "blob via the version handshake)")
+_def("RAY_TPU_WEIGHT_SHARDS", int, 1,
+     "Shard count for weight-sync payloads: the flattened f32 "
+     "parameter vector splits into this many equal byte ranges that "
+     "encode/ship/apply independently (each learner replica broadcasts "
+     "only its shard)")
+_def("RAY_TPU_PARAM_SHARDING", str, "replicate",
+     "Learner parameter/optimizer-state partition rule table "
+     "(spec_layout.RULE_TABLES): replicate (legacy layout) | fsdp "
+     "(shard large params + optax moments over the dp axis so each "
+     "replica owns only its slice of the weight update)")
+
 # --- object distribution (location directory + tree broadcast) --------
 _def("RAY_TPU_LOCATION_FETCH", bool, True,
      "Location-aware object distribution: nodes register sealed "

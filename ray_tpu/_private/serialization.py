@@ -150,6 +150,7 @@ def dumps(value) -> bytes:
 WIRE_RAW = 0
 WIRE_ZLIB = 1
 WIRE_LZ4 = 2
+WIRE_Q8D = 3  # int8-quantized f32 delta against a receiver-held base
 
 try:  # pragma: no cover - lz4 not in the base image
     import lz4.frame as _lz4
@@ -171,10 +172,13 @@ except ImportError:
 WIRE_PROBE_BYTES = 16 * 1024
 
 
-def wire_decode(codec: int, payload):
+def wire_decode(codec: int, payload, base=None):
     """Inverse of the per-chunk encode; dispatches on the WIRE flag the
     chunk carries (mixed streams decode correctly). RAW payloads pass
-    through unchanged — a memoryview stays a zero-copy view."""
+    through unchanged — a memoryview stays a zero-copy view. WIRE_Q8D
+    chunks additionally need the matching byte range of the base blob
+    the sender delta-encoded against (delta streams are
+    position-synchronous: both sides walk the base in chunk order)."""
     if codec == WIRE_RAW:
         return payload
     if codec == WIRE_ZLIB:
@@ -182,7 +186,81 @@ def wire_decode(codec: int, payload):
     if codec == WIRE_LZ4:
         import lz4.frame as lz4f  # sender had lz4; symmetric images do
         return lz4f.decompress(payload)
+    if codec == WIRE_Q8D:
+        if base is None:
+            raise ValueError(
+                "WIRE_Q8D chunk needs the receiver-held base window")
+        return q8d_decode(payload, base)
     raise ValueError(f"unknown wire codec {codec}")
+
+
+# ---------------------------------------------------------------------
+# q8 block quantization: the shared primitive under both the chunk-level
+# WIRE_Q8D codec and the weight-sync delta plane (weight_sync.py). One
+# f32 scale per Q8_BLOCK elements bounds the per-element error at
+# max|block| / 254 — tight enough that sender-side error feedback keeps
+# learning curves on the full-sync trajectory.
+# ---------------------------------------------------------------------
+Q8_BLOCK = 1024
+_Q8HDR = struct.Struct("<I")
+
+
+def q8_quantize(vec):
+    """f32[n] -> (q int8[n], scales f32[ceil(n/Q8_BLOCK)])."""
+    import numpy as np
+    vec = np.ascontiguousarray(vec, dtype=np.float32)
+    n = vec.size
+    nb = max(1, -(-n // Q8_BLOCK))
+    padded = np.zeros(nb * Q8_BLOCK, np.float32)
+    padded[:n] = vec
+    blocks = padded.reshape(nb, Q8_BLOCK)
+    scales = np.abs(blocks).max(axis=1) / 127.0
+    scales[scales == 0.0] = 1.0
+    scales = scales.astype(np.float32)
+    q = np.clip(np.rint(blocks / scales[:, None]), -127, 127) \
+        .astype(np.int8)
+    return q.reshape(-1)[:n].copy(), scales
+
+
+def q8_dequantize(q, scales):
+    """Inverse of q8_quantize — EXACTLY the arithmetic the sender uses
+    to maintain its receiver-view base (f32 multiply), so sender and
+    receiver reconstructions are bit-identical."""
+    import numpy as np
+    q = np.asarray(q, np.int8)
+    n = q.size
+    out = q.astype(np.float32)
+    out *= np.repeat(np.asarray(scales, np.float32),
+                     Q8_BLOCK)[:n]
+    return out
+
+
+def q8d_encode(chunk, base) -> bytes:
+    """Delta-quantize one f32 byte window against its base window:
+    payload = u32 n_elems | f32 scales[nb] | int8 q[n]. Lossy by
+    construction — only senders that account the residual (weight-sync
+    error feedback) may use it."""
+    import numpy as np
+    new = np.frombuffer(chunk, dtype=np.float32)
+    old = np.frombuffer(base, dtype=np.float32)
+    if new.size != old.size:
+        raise ValueError("q8d chunk/base length mismatch")
+    q, scales = q8_quantize(new - old)
+    return _Q8HDR.pack(q.size) + scales.tobytes() + q.tobytes()
+
+
+def q8d_decode(payload, base) -> bytes:
+    """Reconstruct the f32 byte window: base + dequant(q)."""
+    import numpy as np
+    mv = memoryview(payload)
+    (n,) = _Q8HDR.unpack_from(mv, 0)
+    nb = max(1, -(-n // Q8_BLOCK))
+    off = _Q8HDR.size
+    scales = np.frombuffer(mv[off:off + 4 * nb], np.float32)
+    q = np.frombuffer(mv[off + 4 * nb:off + 4 * nb + n], np.int8)
+    out = np.frombuffer(base, np.float32).copy()
+    out += q8_dequantize(q, scales)
+    return out.tobytes()
 
 
 class StreamEncoder:
@@ -198,15 +276,31 @@ class StreamEncoder:
     on a multi-GB/s loopback the codec is pure added latency, while on
     the multi-MB/s links the Podracer obs stream is bound by it pays
     for itself many times over.
+
+    `wire_codec="q8_delta"` (with `base`, the previous version of the
+    SAME stream the receiver already holds) arms the delta slot: each
+    chunk whose byte range lies inside the base and is f32-aligned ships
+    as a WIRE_Q8D int8 delta (~4x smaller); everything else falls back
+    to the normal raw/compressed path, so one stream freely mixes
+    q8_delta and raw chunks. Only weight-sync senders that carry the
+    quantization residual forward (error feedback) should arm this — the
+    reconstruction is lossy by design.
     """
 
-    __slots__ = ("enabled", "min_ratio", "_probed")
+    __slots__ = ("enabled", "min_ratio", "_probed", "_delta_base",
+                 "_delta_pos")
 
     def __init__(self, mode: str = "auto", min_ratio: float = 0.9,
                  link_mbps: Optional[float] = None,
-                 max_link_mbps: float = 200.0):
+                 max_link_mbps: float = 200.0,
+                 wire_codec: Optional[str] = None,
+                 base=None):
         self.min_ratio = min_ratio
         self._probed = False
+        self._delta_base = None
+        self._delta_pos = 0
+        if wire_codec == "q8_delta" and base is not None:
+            self._delta_base = memoryview(base).cast("B")
         if mode == "off":
             self.enabled = False
             self._probed = True
@@ -235,6 +329,15 @@ class StreamEncoder:
         """Returns (codec_flag, wire_payload) for one chunk. RAW
         chunks pass through uncopied (the transport scatter-gathers
         them out-of-band)."""
+        if self._delta_base is not None:
+            mv = memoryview(chunk).cast("B")
+            pos, n = self._delta_pos, mv.nbytes
+            self._delta_pos += n  # base walk advances even on fallback
+            if (pos + n <= self._delta_base.nbytes and n % 4 == 0
+                    and n >= 64):
+                payload = q8d_encode(mv, self._delta_base[pos:pos + n])
+                if len(payload) < n * self.min_ratio:
+                    return WIRE_Q8D, payload
         if not self._probed:
             self.probe(chunk)
         if not self.enabled:

@@ -1,0 +1,219 @@
+"""SpecLayout: the first-class mesh/PartitionSpec layer.
+
+Both training stacks (``rllib/policy/jax_policy.py`` and
+``sgd/jax_trainer.py``) used to hard-code full replication: every learner
+replica materialized every parameter and every optimizer slot, and every
+weight broadcast shipped the whole tree. This module replaces that with a
+rule-table resolution step (the `match_partition_rules` idiom from the
+LLM-training stacks): a table of ``(regex, PartitionSpec)`` pairs is
+matched against each parameter's tree path, producing a sharding pytree
+that drives ``jax.jit`` in/out shardings. With the default ``replicate``
+table the resolved program is bit-identical to the old hard-coded one; the
+``fsdp`` table shards each non-scalar leaf across the "dp" axis so a
+replica only ever materializes (and broadcasts) its own parameter shard —
+the "Automatic Cross-Replica Sharding of Weight Update" layout.
+
+Optimizer state resolves through the SAME table: optax slots mirror the
+parameter tree (``mu/conv_0/kernel`` still re.search-matches a
+``conv_0/kernel`` rule), and scalar slots (step counters) always replicate.
+
+Rules never force an invalid layout: a spec whose sharded dimensions do
+not tile the leaf's shape on this mesh silently falls back to
+replication for that leaf (small models on big meshes stay correct).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+Rules = Sequence[Tuple[str, P]]
+
+
+def tree_paths(tree, sep: str = "/") -> List[str]:
+    """Flattened ``sep``-joined key path per leaf, in tree_flatten order."""
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for path, _leaf in flat:
+        parts = []
+        for k in path:
+            if hasattr(k, "key"):       # DictKey / FlattenedIndexKey
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):     # SequenceKey
+                parts.append(str(k.idx))
+            elif hasattr(k, "name"):    # GetAttrKey (optax namedtuples)
+                parts.append(str(k.name))
+            else:
+                parts.append(str(k))
+        out.append(sep.join(parts))
+    return out
+
+
+def named_tree_map(fn, tree, sep: str = "/"):
+    """``jax.tree.map`` variant passing ``fn(name, leaf)`` where name is
+    the sep-joined tree path (the `named_tree_map` idiom the rule tables
+    are written against)."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    names = tree_paths(tree, sep=sep)
+    return jax.tree_util.tree_unflatten(
+        treedef, [fn(name, leaf) for name, (_, leaf) in zip(names, flat)])
+
+
+def _spec_fits(spec: P, shape, mesh: Mesh) -> bool:
+    """A spec is usable iff every named axis exists on the mesh and each
+    sharded dimension tiles evenly."""
+    if len(spec) > len(shape):
+        return False
+    for dim, entry in enumerate(spec):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        n = 1
+        for ax in axes:
+            if ax not in mesh.shape:
+                return False
+            n *= mesh.shape[ax]
+        if n == 0 or shape[dim] % n:
+            return False
+    return True
+
+
+def match_partition_rules(rules: Rules, tree, mesh: Optional[Mesh] = None,
+                          default: P = P()):
+    """Resolve a pytree of PartitionSpecs from a rule table.
+
+    Each leaf's tree path is matched (``re.search``) against the rules in
+    order; first hit wins. Scalars (and leaves the winning spec cannot
+    tile on ``mesh``) resolve to replication. Unmatched leaves take
+    ``default`` — pass a sentinel-raising default for strict tables.
+    """
+    def resolve(name: str, leaf) -> P:
+        shape = tuple(getattr(leaf, "shape", ()))
+        if len(shape) == 0 or int(np.prod(shape)) == 1:
+            return P()  # never partition scalars
+        for rule, spec in rules:
+            if re.search(rule, name) is not None:
+                if mesh is not None and not _spec_fits(spec, shape, mesh):
+                    return P()
+                return spec
+        return default
+
+    return named_tree_map(resolve, tree)
+
+
+# ---------------------------------------------------------------------
+# Rule tables. Layer names come from models/networks.py (conv_i / fc_i /
+# logits / value / lstm) and sgd user models; optax slots prefix these
+# paths (mu/..., nu/...), which re.search still matches.
+# ---------------------------------------------------------------------
+REPLICATE_RULES: Rules = (
+    (r".*", P()),
+)
+
+# FSDP-style weight-update sharding over the "dp" axis: each replica owns
+# a 1/N slice of every large parameter (and, via path-suffix matching, of
+# its optimizer moments), so no replica materializes the full update.
+# Conv kernels shard on the output-channel dim, dense kernels on the
+# input dim (the large one for the Nature-CNN 3136x512 fc), vectors on
+# their only dim.
+FSDP_RULES: Rules = (
+    (r"conv_\d+/kernel", P(None, None, None, "dp")),
+    (r"(fc(_\d+)?|logits|value|advantage|state_value|q|out"
+     r"|vf_\d+)/kernel", P("dp", None)),
+    (r"lstm.*/kernel", P("dp", None)),
+    (r"/bias$", P("dp")),
+    (r".*", P()),
+)
+
+RULE_TABLES = {
+    "replicate": REPLICATE_RULES,
+    "fsdp": FSDP_RULES,
+}
+
+
+class SpecLayout:
+    """Mesh + rule table, resolved on demand against parameter pytrees.
+
+    The one object both training stacks share: policies/trainers ask it
+    for param/opt-state shardings (jit in/out shardings), replicated and
+    batch shardings, and host-side shard slicing for the weight-sync
+    delta plane.
+    """
+
+    def __init__(self, mesh: Mesh, rules: Rules = REPLICATE_RULES,
+                 batch_axis: str = "dp"):
+        self.mesh = mesh
+        self.rules = tuple(rules)
+        self.batch_axis = batch_axis
+
+    # -- construction --------------------------------------------------
+    @classmethod
+    def from_config(cls, mesh: Mesh, table: Optional[Any] = None,
+                    batch_axis: str = "dp") -> "SpecLayout":
+        """``table`` is a RULE_TABLES name, an explicit (regex, spec)
+        sequence, or None (-> RAY_TPU_PARAM_SHARDING)."""
+        if table is None:
+            from . import config as config_mod
+            table = config_mod.get("RAY_TPU_PARAM_SHARDING")
+        if isinstance(table, str):
+            if table not in RULE_TABLES:
+                raise ValueError(
+                    f"unknown partition rule table {table!r}; known: "
+                    f"{sorted(RULE_TABLES)} (or pass explicit rules)")
+            rules = RULE_TABLES[table]
+        else:
+            rules = tuple(
+                (r, s if isinstance(s, P) else P(*s)) for r, s in table)
+        return cls(mesh, rules, batch_axis=batch_axis)
+
+    # -- spec / sharding resolution ------------------------------------
+    def specs(self, tree):
+        """Pytree of PartitionSpec resolved from the rule table."""
+        return match_partition_rules(self.rules, tree, mesh=self.mesh)
+
+    def shardings(self, tree):
+        """Pytree of NamedSharding matching ``tree`` (jit in/out
+        shardings; also a valid ``jax.device_put`` target)."""
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                            self.specs(tree),
+                            is_leaf=lambda x: isinstance(x, P))
+
+    def replicated(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P())
+
+    def batch(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.batch_axis))
+
+    def put(self, tree):
+        """Place a host pytree according to the resolved layout."""
+        return jax.device_put(tree, self.shardings(tree))
+
+    def is_replicated(self) -> bool:
+        """True iff the table resolves everything to replication (the
+        legacy layout — lets callers keep byte-identical fast paths)."""
+        return all(spec == P() or not len(spec)
+                   for _, spec in self.rules)
+
+    def describe(self, tree) -> dict:
+        """name -> spec string, for dryruns/tests/debugging."""
+        flat_specs = jax.tree.leaves(
+            self.specs(tree), is_leaf=lambda x: isinstance(x, P))
+        return {name: str(spec)
+                for name, spec in zip(tree_paths(tree), flat_specs)}
+
+
+# ---------------------------------------------------------------------
+# Host-side shard slicing: the weight-sync delta plane partitions the
+# FLATTENED f32 parameter vector into equal byte ranges, so shard
+# payloads stay balanced regardless of leaf-size skew (the Nature-CNN fc
+# kernel is ~93% of the tree).
+# ---------------------------------------------------------------------
+def shard_bounds(n: int, shard_count: int) -> List[Tuple[int, int]]:
+    """Equal [start, stop) element ranges covering [0, n)."""
+    shard_count = max(1, int(shard_count))
+    return [(s * n // shard_count, (s + 1) * n // shard_count)
+            for s in range(shard_count)]

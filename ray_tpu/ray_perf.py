@@ -250,6 +250,105 @@ def broadcast_benchmarks(quick: bool = False,
     return results
 
 
+def weight_sync_benchmarks(quick: bool = False, borrowers: int = 4,
+                           arms=("full", "q8_delta", "q8_delta_s4")):
+    """Weight-sync A/B over the RLlib broadcast shape: a learner-side
+    encoder versions Nature-CNN-sized weights each "update" (small
+    param perturbation per sync, like an optimizer step), ships payloads
+    to N receiver actors on a second node, and each receiver applies
+    them through the WeightSyncDecoder. Reports per-sync wire bytes
+    (owner egress), payload bytes, and latency for: full blobs,
+    q8_delta, and sharded (4-way) q8_delta."""
+    import statistics
+
+    import jax
+
+    import ray_tpu
+    from ray_tpu._private import config as config_mod
+    from ray_tpu._private import metrics as metrics_mod
+    from ray_tpu._private.weight_sync import (WeightSyncDecoder,
+                                              WeightSyncEncoder)
+    from ray_tpu.cluster_utils import Cluster
+    from ray_tpu.models.networks import VisionNetwork
+
+    config_mod.set_override("RAY_TPU_WIRE_COMPRESSION", "off")
+    results = {}
+    model = VisionNetwork(num_outputs=6)
+    weights = jax.tree.map(
+        np.asarray, model.init(
+            jax.random.PRNGKey(0),
+            np.zeros((1, 84, 84, 4), np.uint8)))
+    total_mb = sum(np.asarray(l).nbytes
+                   for l in jax.tree.leaves(weights)) / (1 << 20)
+    print(f"weight blob: {total_mb:.1f} MB (Nature-CNN)")
+    syncs = 3 if quick else 8
+    rng = np.random.default_rng(1)
+
+    for arm in arms:
+        codec, shards = ("full", 1) if arm == "full" else \
+            ("q8_delta", 1) if arm == "q8_delta" else ("q8_delta", 4)
+        cluster = Cluster(head_resources={"CPU": 2})
+        cluster.add_node(resources={"CPU": 2, "WS": float(borrowers)})
+
+        @ray_tpu.remote(resources={"WS": 1})
+        class Receiver:
+            def __init__(self):
+                self._dec = WeightSyncDecoder()
+
+            def apply(self, payload):
+                _, status = self._dec.apply(payload)
+                return status, self._dec.version
+
+        fleet = [Receiver.remote() for _ in range(borrowers)]
+        enc = WeightSyncEncoder(codec=codec, shard_count=shards)
+        w = weights
+        times, egress, pay = [], [], []
+        for i in range(syncs + 1):
+            payloads = enc.encode(w)
+            before = metrics_mod.snapshot()["counters"].get(
+                "wire_bytes_on_wire", 0.0)
+            t0 = time.perf_counter()
+            refs = [ray_tpu.put(p) for p in payloads]
+            acks = ray_tpu.get(
+                [f.apply.remote(r) for f in fleet for r in refs],
+                timeout=180)
+            dt = time.perf_counter() - t0
+            assert all(s in ("ok", "partial") for s, _ in acks), acks
+            if i > 0:  # sync 0 is the full base establishment
+                times.append(dt)
+                egress.append(metrics_mod.snapshot()["counters"].get(
+                    "wire_bytes_on_wire", 0.0) - before)
+                pay.append(sum(p.nbytes for p in payloads))
+            # The "optimizer step": adam-sized perturbation per sync.
+            w = jax.tree.map(
+                lambda x: x + (5e-4 * rng.standard_normal(
+                    x.shape)).astype(x.dtype), w)
+        results[f"wsync_{arm}_ms"] = 1e3 * statistics.median(times)
+        results[f"wsync_{arm}_payload_mb"] = \
+            statistics.median(pay) / (1 << 20)
+        results[f"wsync_{arm}_egress_mb"] = \
+            statistics.median(egress) / (1 << 20)
+        results[f"wsync_{arm}_times_ms"] = [1e3 * t for t in times]
+        results[f"wsync_{arm}_egress_raw_mb"] = \
+            [e / (1 << 20) for e in egress]
+        print(f"weight sync [{arm:>12s}] x{borrowers}   "
+              f"{results[f'wsync_{arm}_ms']:>8.1f} ms   payload "
+              f"{results[f'wsync_{arm}_payload_mb']:.2f} MB   egress "
+              f"{results[f'wsync_{arm}_egress_mb']:.2f} MB")
+        cluster.shutdown()
+    return results
+
+
+def weight_sync_ab(quick: bool = False, cycles: int = 3):
+    """Interleaved A/B: the three arms alternate cluster boots (the
+    PERF.md variance protocol — medians pool across cycles)."""
+    out = []
+    for i in range(cycles):
+        print(f"--- weight-sync cycle {i} ---")
+        out.append(weight_sync_benchmarks(quick=quick))
+    return out
+
+
 def broadcast_ab(quick: bool = False, cycles: int = 1):
     """Interleaved same-session A/B: owner-only vs location-aware arms
     alternate cluster boots (PERF.md round-7 protocol)."""
@@ -271,8 +370,14 @@ if __name__ == "__main__":
     parser.add_argument("--broadcast", action="store_true",
                         help="run only the 1->N broadcast benchmark "
                              "(both arms, interleaved)")
+    parser.add_argument("--weight-sync", action="store_true",
+                        help="run only the weight-sync codec A/B "
+                             "(full vs q8_delta vs sharded+delta, "
+                             "interleaved)")
     args = parser.parse_args()
-    if args.broadcast:
+    if args.weight_sync:
+        weight_sync_ab(quick=args.quick)
+    elif args.broadcast:
         broadcast_ab(quick=args.quick)
     elif args.transfer_only:
         transfer_benchmarks(quick=args.quick)

@@ -66,7 +66,8 @@ A3CTrainer = build_trainer(
     default_policy=A3CJaxPolicy,
     default_config=DEFAULT_CONFIG,
     make_policy_optimizer=lambda workers, config: AsyncGradientsOptimizer(
-        workers, grads_per_step=config.get("grads_per_step", 100)))
+        workers, grads_per_step=config.get("grads_per_step", 100),
+        weight_sync_codec=config.get("weight_sync_codec", "auto")))
 
 A2CTrainer = build_trainer(
     name="A2C",

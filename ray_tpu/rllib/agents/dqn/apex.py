@@ -47,7 +47,8 @@ def make_async_replay_optimizer(workers, config):
         max_weight_sync_delay=config["optimizer"]["max_weight_sync_delay"],
         prioritized_replay_alpha=config["prioritized_replay_alpha"],
         prioritized_replay_beta=config["prioritized_replay_beta"],
-        prioritized_replay_eps=config["prioritized_replay_eps"])
+        prioritized_replay_eps=config["prioritized_replay_eps"],
+        weight_sync_codec=config.get("weight_sync_codec", "auto"))
 
 
 def setup_apex_exploration(trainer):

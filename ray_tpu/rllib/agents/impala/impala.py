@@ -55,7 +55,8 @@ def make_async_optimizer(workers, config):
         # Sebulba pipeline gears (see evaluation/device_sampler.py):
         # double-buffered env groups + k-step on-device selection.
         sebulba_env_groups=config.get("sebulba_env_groups", 2),
-        sebulba_onchip_steps=config.get("sebulba_onchip_steps", 1))
+        sebulba_onchip_steps=config.get("sebulba_onchip_steps", 1),
+        weight_sync_codec=config.get("weight_sync_codec", "auto"))
 
 
 def validate_config(config):

@@ -51,6 +51,18 @@ COMMON_CONFIG = {
     "optimizer": {},
     "grad_clip": None,
     "seed": None,
+    # Weight broadcast codec (_private/weight_sync.py): "auto" defers
+    # to RAY_TPU_WEIGHT_CODEC (default q8_delta — int8 block-quantized
+    # deltas with sender-side error feedback and a version handshake
+    # that full-syncs stale receivers); "full" ships the whole float32
+    # tree every sync.
+    "weight_sync_codec": "auto",
+    # Learner parameter partition rule table (_private/spec_layout.py):
+    # "auto" defers to RAY_TPU_PARAM_SHARDING ("replicate" keeps the
+    # legacy fully-replicated layout; "fsdp" shards large params and
+    # their optax moments over the dp mesh axis), or an explicit
+    # [(regex, PartitionSpec)] rule list.
+    "param_sharding": "auto",
     # === Environment ===
     "env": None,
     "env_config": {},

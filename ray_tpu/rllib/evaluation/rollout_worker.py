@@ -38,6 +38,9 @@ class RolloutWorker:
                  horizon: Optional[int] = None,
                  pack_fragments: bool = False):
         self.worker_index = worker_index
+        # Receiver side of the weight-sync delta plane (lazily built on
+        # the first versioned payload).
+        self._weight_decoder = None
         # Compression only pays where batches cross a process boundary
         # (remote worker -> learner); the local worker's batches are
         # consumed in-process.
@@ -259,11 +262,42 @@ class RolloutWorker:
         return self.policy.get_weights()
 
     def set_weights(self, weights):
+        """Apply a weight sync: either a raw weights pytree (legacy
+        path) or a versioned `WeightSyncPayload` from the delta plane.
+        Returns a status dict the sender's handshake reads — a "stale"
+        status (delta against a base this worker doesn't hold) leaves
+        the current weights untouched and makes the sender fall back to
+        a full payload."""
+        import time as _time
+
+        from ray_tpu._private import metrics
+        from ray_tpu._private.weight_sync import WeightSyncPayload
+        if isinstance(weights, WeightSyncPayload):
+            if self._weight_decoder is None:
+                from ray_tpu._private.weight_sync import WeightSyncDecoder
+                self._weight_decoder = WeightSyncDecoder()
+            t0 = _time.perf_counter()
+            decoded, status = self._weight_decoder.apply(weights)
+            metrics.set_gauge("weight_apply_ms",
+                              1e3 * (_time.perf_counter() - t0))
+            if status == "stale":
+                metrics.inc("weight_sync_stale_received")
+            if decoded is None:
+                return {"status": status,
+                        "version": self._weight_decoder.version}
+            weights = decoded
+        elif self._weight_decoder is not None:
+            # A raw-dict sync outside the versioned stream invalidates
+            # the delta base (checkpoint restore, manual set_weights).
+            self._weight_decoder.reset()
         if self.policy_map is not None:
             for pid, w in weights.items():
                 self.policy_map[pid].set_weights(w)
-            return
-        self.policy.set_weights(weights)
+        else:
+            self.policy.set_weights(weights)
+        version = (self._weight_decoder.version
+                   if self._weight_decoder is not None else 0)
+        return {"status": "ok", "version": version}
 
     # -- filters (parity: FilterManager.synchronize) ---------------------
     def get_filters(self, flush_after: bool = False):

@@ -45,6 +45,7 @@ class WorkerSet:
             horizon=config.get("horizon"),
             pack_fragments=config.get("pack_fragments", False))
         self.remote_workers: List = []
+        self._broadcaster = None  # weight-sync delta plane (lazy)
         if num_workers > 0:
             self._remote_cls = ray_tpu.remote(RolloutWorker)
             for i in range(num_workers):
@@ -88,13 +89,20 @@ class WorkerSet:
 
     # ------------------------------------------------------------------
     def sync_weights(self):
-        """Broadcast local policy weights to all remote workers
-        (reference: ray.put broadcast in the optimizers)."""
+        """Broadcast local policy weights to all remote workers through
+        the weight-sync delta plane (one encode + put per call; each
+        worker gets the q8 delta against the version it holds, or the
+        full blob when its base is stale/missing)."""
         if not self.remote_workers:
             return
-        weights = ray_tpu.put(self.local_worker.get_weights())
-        ray_tpu.get([w.set_weights.remote(weights)
-                     for w in self.remote_workers])
+        if self._broadcaster is None:
+            from ..utils.weight_broadcast import WeightBroadcaster
+            policy_config = dict(
+                self._config.get("policy_config") or self._config)
+            self._broadcaster = WeightBroadcaster(
+                self.local_worker.get_weights,
+                codec=policy_config.get("weight_sync_codec", "auto"))
+        self._broadcaster.sync_all_blocking(self.remote_workers)
 
     def sync_filters(self):
         """Merge remote MeanStdFilter deltas into the local filter and
@@ -116,6 +124,9 @@ class WorkerSet:
         new = self._make_remote_worker(idx + 1)
         ray_tpu.get(new.ping.remote())
         self.remote_workers[idx] = new
+        if self._broadcaster is not None:
+            # The replacement holds no delta base: next sync full-blobs.
+            self._broadcaster.forget(worker)
         return new
 
     def stop(self):

@@ -4,18 +4,27 @@ Parity: `rllib/optimizers/async_gradients_optimizer.py` — each worker
 samples and computes gradients on its own policy copy; the driver applies
 them to the learner policy as they arrive (stale by design) and ships
 fresh weights back to that worker only.
+
+Weight returns ride the weight-sync delta plane
+(`utils/weight_broadcast.py`): gradients from one `completed()` drain are
+applied first, then the resulting weights encode ONCE (one put per
+update) and every drained worker syncs from that version — replacing the
+old per-worker `ray_tpu.put(get_weights())`, which re-serialized and
+re-stored the full float32 tree once per worker per iteration.
 """
 
 from __future__ import annotations
 
-import ray_tpu
-
 from ..utils.actors import TaskPool
+from ..utils.weight_broadcast import WeightBroadcaster
 from .policy_optimizer import PolicyOptimizer
+
+import ray_tpu
 
 
 class AsyncGradientsOptimizer(PolicyOptimizer):
-    def __init__(self, workers, grads_per_step: int = 100):
+    def __init__(self, workers, grads_per_step: int = 100,
+                 weight_sync_codec: str = "auto"):
         super().__init__(workers)
         self.grads_per_step = grads_per_step
         self.learner_stats = {}
@@ -23,25 +32,42 @@ class AsyncGradientsOptimizer(PolicyOptimizer):
             raise ValueError(
                 "AsyncGradientsOptimizer requires num_workers > 0")
         self.grad_tasks = TaskPool()
-        weights = ray_tpu.put(self.workers.local_worker.get_weights())
+        self._broadcaster = WeightBroadcaster(
+            lambda: self.workers.local_worker.get_weights(),
+            codec=weight_sync_codec)
+        self._broadcaster.broadcast()
         for w in self.workers.remote_workers:
-            w.set_weights.remote(weights)
+            self._broadcaster.sync(w)
             self.grad_tasks.add(w, w.sample_and_compute_grads.remote())
 
     def step(self) -> dict:
         applied = 0
         while applied < self.grads_per_step:
-            for worker, ref in self.grad_tasks.completed(blocking_wait=True):
+            # Apply every drained gradient before re-encoding: the
+            # weights each worker gets back are at most one drain stale
+            # (A3C is stale-by-design), and the encode+put happens once
+            # per update instead of once per worker.
+            drained = []
+            for worker, ref in self.grad_tasks.completed(
+                    blocking_wait=True):
                 grads, stats, count = ray_tpu.get(ref)
                 self.workers.local_worker.apply_gradients(grads)
                 self.learner_stats = stats
                 self.num_steps_sampled += count
                 self.num_steps_trained += count
                 applied += 1
-                worker.set_weights.remote(ray_tpu.put(
-                    self.workers.local_worker.get_weights()))
-                self.grad_tasks.add(
-                    worker, worker.sample_and_compute_grads.remote())
+                drained.append(worker)
                 if applied >= self.grads_per_step:
                     break
+            if drained:
+                self._broadcaster.broadcast()
+            for worker in drained:
+                self._broadcaster.sync(worker)
+                self.grad_tasks.add(
+                    worker, worker.sample_and_compute_grads.remote())
         return self.learner_stats
+
+    def stats(self) -> dict:
+        out = super().stats()
+        out.update(self._broadcaster.stats())
+        return out

@@ -141,12 +141,17 @@ class AsyncReplayOptimizer(PolicyOptimizer):
                  prioritized_replay_alpha: float = 0.6,
                  prioritized_replay_beta: float = 0.4,
                  prioritized_replay_eps: float = 1e-6,
-                 debug: bool = False):
+                 debug: bool = False,
+                 weight_sync_codec: str = "auto"):
         super().__init__(workers)
         self.learning_starts = learning_starts
         self.max_weight_sync_delay = max_weight_sync_delay
         self.learner = _ReplayLearnerThread(workers.local_worker)
         self.learner.start()
+        from ..utils.weight_broadcast import WeightBroadcaster
+        self._broadcaster = WeightBroadcaster(
+            lambda: self.workers.local_worker.get_weights(),
+            codec=weight_sync_codec)
 
         RemoteReplayActor = ray_tpu.remote(ReplayActor)
         self.replay_actors = [
@@ -176,10 +181,10 @@ class AsyncReplayOptimizer(PolicyOptimizer):
 
     # ------------------------------------------------------------------
     def _set_workers(self, remote_workers):
-        weights = ray_tpu.put(self.workers.local_worker.get_weights())
+        self._broadcaster.broadcast()
         for w in remote_workers:
             self.steps_since_update[w] = 0
-            w.set_weights.remote(weights)
+            self._broadcaster.sync(w)
             self._launch_sample(w)
 
     def _launch_sample(self, worker):
@@ -209,7 +214,7 @@ class AsyncReplayOptimizer(PolicyOptimizer):
 
     def _process_samples(self) -> int:
         sampled = 0
-        weights_ref = None
+        broadcasted = False
         for worker, count_ref in self._sample_tasks.completed():
             count = ray_tpu.get(count_ref)
             sampled += count
@@ -218,11 +223,15 @@ class AsyncReplayOptimizer(PolicyOptimizer):
             self.steps_since_update[worker] += count
             if self.steps_since_update[worker] >= \
                     self.max_weight_sync_delay:
-                if weights_ref is None:
-                    weights_ref = ray_tpu.put(
-                        self.workers.local_worker.get_weights())
-                worker.set_weights.remote(weights_ref)
-                self.num_weight_syncs += 1
+                if not broadcasted and self.learner.weights_updated:
+                    # One encode+put per learner version; every due
+                    # worker this round shares it (delta or full per
+                    # its held base).
+                    self.learner.weights_updated = False
+                    self._broadcaster.broadcast()
+                    broadcasted = True
+                if self._broadcaster.sync(worker):
+                    self.num_weight_syncs += 1
                 self.steps_since_update[worker] = 0
             self._launch_sample(worker)
         return sampled
@@ -263,6 +272,7 @@ class AsyncReplayOptimizer(PolicyOptimizer):
     # ------------------------------------------------------------------
     def stats(self) -> dict:
         out = super().stats()
+        out.update(self._broadcaster.stats())
         out.update({
             "num_weight_syncs": self.num_weight_syncs,
             "num_samples_dropped": self.num_samples_dropped,

@@ -196,7 +196,8 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                  obs_delta="auto",
                  obs_delta_budget: int = 256,
                  sebulba_env_groups: int = 1,
-                 sebulba_onchip_steps: int = 1):
+                 sebulba_onchip_steps: int = 1,
+                 weight_sync_codec: str = "auto"):
         super().__init__(workers)
         self.train_batch_size = train_batch_size
         self.rollout_fragment_length = rollout_fragment_length
@@ -216,9 +217,14 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         self.sample_tasks = TaskPool()
         self._batch_buffer: List[SampleBatch] = []
         self._batch_buffer_count = 0
-        self.num_weight_broadcasts = 0
         self.num_steps_since_broadcast = 0
-        self._broadcasted_weights = None
+        # The weight-sync delta plane: one encode+put per learner
+        # update; per-worker versions route q8 deltas vs full blobs and
+        # skip workers that already hold the current broadcast.
+        from ..utils.weight_broadcast import WeightBroadcaster
+        self._broadcaster = WeightBroadcaster(
+            lambda: self.workers.local_worker.get_weights(),
+            codec=weight_sync_codec)
         self.learner_stats = {}
         self._inline_actors: List[InlineActorThread] = []
         self._inline_sampled_seen = 0
@@ -310,10 +316,12 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                     self.sample_tasks.add(w, w.sample.remote())
 
     # ------------------------------------------------------------------
+    @property
+    def num_weight_broadcasts(self) -> int:
+        return self._broadcaster.num_broadcasts
+
     def _broadcast_weights(self):
-        self._broadcasted_weights = ray_tpu.put(
-            self.workers.local_worker.get_weights())
-        self.num_weight_broadcasts += 1
+        self._broadcaster.broadcast()
         self.num_steps_since_broadcast = 0
 
     def step(self) -> dict:
@@ -360,7 +368,11 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                 self.learner.weights_updated = False
                 self._broadcast_weights()
             self.num_steps_since_broadcast += 1
-            worker.set_weights.remote(self._broadcasted_weights)
+            # Version-gated sync: a worker already holding the current
+            # broadcast is skipped (no redundant re-send per completed
+            # sample task); behind-base workers fall back to full blobs
+            # via the handshake in the broadcaster.
+            self._broadcaster.sync(worker)
             self.sample_tasks.add(worker, worker.sample.remote())
         return sampled
 
@@ -443,6 +455,7 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
 
     def stats(self) -> dict:
         out = super().stats()
+        out.update(self._broadcaster.stats())
         out.update({
             "num_weight_broadcasts": self.num_weight_broadcasts,
             "learner_queue": self.learner.learner_queue_size.stats(),

@@ -117,12 +117,23 @@ class JaxPolicy(Policy):
         self.optimizer = (optimizer_fn or default_optimizer)(config)
         self.opt_state = self.optimizer.init(self.params)
 
-        # Mesh: replicate params so the same program spans 1..N devices.
+        # Mesh + layout: the param/opt-state shardings resolve through
+        # the SpecLayout rule table (config "param_sharding": "auto" ->
+        # RAY_TPU_PARAM_SHARDING). The default "replicate" table
+        # reproduces the legacy fully-replicated layout exactly; "fsdp"
+        # shards large params and their optax moments over "dp" so each
+        # replica owns only its slice of the weight update.
+        from ..._private import spec_layout
         self.mesh = config.get("_mesh")
         if self.mesh is None:
             self.mesh = mesh_lib.make_mesh(num_devices=1)
-        self.params = mesh_lib.put_replicated(self.params, self.mesh)
-        self.opt_state = mesh_lib.put_replicated(self.opt_state, self.mesh)
+        table = config.get("param_sharding", "auto")
+        self.layout = spec_layout.SpecLayout.from_config(
+            self.mesh, None if table == "auto" else table)
+        self._param_sh = self.layout.shardings(self.params)
+        self._opt_sh = self.layout.shardings(self.opt_state)
+        self.params = jax.device_put(self.params, self._param_sh)
+        self.opt_state = jax.device_put(self.opt_state, self._opt_sh)
         self._repl = mesh_lib.replicated(self.mesh)
         self._bsharded = mesh_lib.batch_sharded(self.mesh)
 
@@ -245,9 +256,9 @@ class JaxPolicy(Policy):
 
         self._train_fn = jax.jit(
             train_fn, donate_argnums=(0, 1),
-            in_shardings=(self._repl, self._repl, self._bsharded, self._repl,
-                          self._repl),
-            out_shardings=(self._repl, self._repl, self._repl))
+            in_shardings=(self._param_sh, self._opt_sh, self._bsharded,
+                          self._repl, self._repl),
+            out_shardings=(self._param_sh, self._opt_sh, self._repl))
 
         def grad_fn(params, batch, rng, loss_state):
             loss, stats, grads = loss_and_grad(params, batch, rng, loss_state)
@@ -256,8 +267,9 @@ class JaxPolicy(Policy):
 
         self._grad_fn = jax.jit(
             grad_fn,
-            in_shardings=(self._repl, self._bsharded, self._repl, self._repl),
-            out_shardings=(self._repl, self._repl))
+            in_shardings=(self._param_sh, self._bsharded, self._repl,
+                          self._repl),
+            out_shardings=(self._param_sh, self._repl))
 
         def apply_grads_fn(params, opt_state, grads):
             updates, opt_state = self.optimizer.update(
@@ -486,9 +498,9 @@ class JaxPolicy(Policy):
 
         return jax.jit(
             sgd_fn, donate_argnums=(0, 1),
-            in_shardings=(self._repl, self._repl, self._bsharded, self._repl,
-                          self._repl),
-            out_shardings=(self._repl, self._repl, self._repl))
+            in_shardings=(self._param_sh, self._opt_sh, self._bsharded,
+                          self._repl, self._repl),
+            out_shardings=(self._param_sh, self._opt_sh, self._repl))
 
     def compute_gradients(self, batch):
         dev_batch = self._device_batch(batch)
@@ -511,7 +523,7 @@ class JaxPolicy(Policy):
 
     def set_weights(self, weights):
         with self._update_lock:
-            self.params = mesh_lib.put_replicated(weights, self.mesh)
+            self.params = jax.device_put(weights, self._param_sh)
 
     def get_state(self):
         return {
@@ -523,8 +535,8 @@ class JaxPolicy(Policy):
 
     def set_state(self, state):
         self.set_weights(state["weights"])
-        self.opt_state = mesh_lib.put_replicated(
-            jax.tree.map(jnp.asarray, state["opt_state"]), self.mesh)
+        self.opt_state = jax.device_put(
+            jax.tree.map(jnp.asarray, state["opt_state"]), self._opt_sh)
         self.global_timestep = state.get("global_timestep", 0)
         for k, v in state.get("loss_state", {}).items():
             self.loss_state[k] = jnp.asarray(v, jnp.float32)

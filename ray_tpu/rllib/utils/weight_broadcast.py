@@ -1,0 +1,173 @@
+"""Sender-side weight-sync state machine for the optimizer broadcast
+paths.
+
+Wraps ``_private/weight_sync.WeightSyncEncoder`` with the bookkeeping
+every optimizer used to improvise (or skip):
+
+- one encode + one ``ray_tpu.put`` per learner update, never per worker;
+- per-worker last-shipped versions, so a worker that already holds the
+  current broadcast is never re-sent it (the no-op re-broadcast fix);
+- delta-vs-full routing per worker: a worker whose last-shipped version
+  matches the delta's base gets the (4x smaller) delta payload, anyone
+  else — new workers, recreated workers, workers that missed a sync —
+  transparently gets the full blob at the same version;
+- the stale-base handshake: ``set_weights`` acks flow back through a
+  TaskPool; a ``stale`` ack (receiver base mismatch, e.g. chaos
+  ``weights.sync``) forgets that worker's version and immediately
+  re-ships the full payload.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional
+
+import ray_tpu
+
+from .actors import TaskPool
+
+logger = logging.getLogger(__name__)
+
+
+class WeightBroadcaster:
+    def __init__(self, get_weights: Callable, codec: str = "auto",
+                 shard_count: Optional[int] = None):
+        from ray_tpu._private import config as config_mod
+        from ray_tpu._private import weight_sync
+        self._get_weights = get_weights
+        self.encoder = weight_sync.WeightSyncEncoder(
+            codec=codec,
+            shard_count=shard_count if shard_count is not None
+            else config_mod.get("RAY_TPU_WEIGHT_SHARDS"))
+        self._worker_versions: Dict = {}
+        self._payload_refs = None
+        self._base_version = None
+        self._full_refs_cache = None
+        self._acks = TaskPool()
+        self.num_broadcasts = 0
+        self.num_skipped = 0
+        self.num_stale_fallbacks = 0
+
+    # ------------------------------------------------------------------
+    @property
+    def version(self) -> int:
+        return self.encoder.version
+
+    def broadcast(self) -> None:
+        """Encode the current learner weights as a new sync version (ONE
+        put per update, shared by every worker)."""
+        payloads = self.encoder.encode(self._get_weights())
+        self._payload_refs = [ray_tpu.put(p) for p in payloads]
+        self._base_version = payloads[0].base_version
+        self._full_refs_cache = (
+            self._payload_refs if payloads[0].base_version is None
+            else None)
+        self.num_broadcasts += 1
+
+    def sync(self, worker) -> bool:
+        """Ship the current version to ``worker`` unless it already
+        holds it. Returns True iff payloads were sent."""
+        self.drain_acks()
+        return self._send(worker)
+
+    def _send(self, worker) -> bool:
+        from ray_tpu._private import chaos, metrics
+        v = self.encoder.version
+        if v == 0:
+            return False
+        last = self._worker_versions.get(worker)
+        if last == v:
+            self.num_skipped += 1
+            metrics.inc("weight_sync_skipped")
+            return False
+        if chaos.controller is not None:
+            rule = chaos.controller.fire("weights.sync", f"v{v}")
+            if rule is not None and rule.kind == "drop":
+                # Recorded as delivered, never shipped: the worker's
+                # base falls behind and the next delta's ack comes back
+                # stale — exactly the handshake under test.
+                self._worker_versions[worker] = v
+                return False
+        if self._base_version is not None and last == self._base_version:
+            refs = self._payload_refs
+        else:
+            refs = self._full_refs()
+        for ref in refs:
+            self._acks.add(worker, worker.set_weights.remote(ref))
+        self._worker_versions[worker] = v
+        return True
+
+    def _full_refs(self):
+        if self._full_refs_cache is None:
+            self._full_refs_cache = [
+                ray_tpu.put(p) for p in self.encoder.full_payloads()]
+        return self._full_refs_cache
+
+    def drain_acks(self) -> None:
+        """Process completed set_weights acks; stale receivers get an
+        immediate full resync."""
+        from ray_tpu._private import metrics
+        for worker, ref in self._acks.completed():
+            try:
+                status = ray_tpu.get(ref)
+            except Exception:
+                # Dead/unreachable worker: forget its version so a
+                # recreated successor starts from a full sync.
+                self._worker_versions.pop(worker, None)
+                continue
+            if isinstance(status, dict) \
+                    and status.get("status") == "stale":
+                self.num_stale_fallbacks += 1
+                metrics.inc("weight_sync_stale_fallbacks")
+                self._worker_versions.pop(worker, None)
+                self._send(worker)
+
+    def sync_all_blocking(self, workers) -> None:
+        """Synchronous fan-out (WorkerSet.sync_weights): broadcast the
+        current weights, ship to every worker, wait for the acks, and
+        resolve any stale handshake inline before returning."""
+        from ray_tpu._private import chaos, metrics
+        self.broadcast()
+        v = self.encoder.version
+        pending: Dict = {}
+        for worker in workers:
+            last = self._worker_versions.get(worker)
+            if last == v:
+                self.num_skipped += 1
+                metrics.inc("weight_sync_skipped")
+                continue
+            if chaos.controller is not None:
+                rule = chaos.controller.fire("weights.sync", f"v{v}")
+                if rule is not None and rule.kind == "drop":
+                    self._worker_versions[worker] = v
+                    continue
+            if self._base_version is not None \
+                    and last == self._base_version:
+                refs = self._payload_refs
+            else:
+                refs = self._full_refs()
+            pending[worker] = [worker.set_weights.remote(r)
+                               for r in refs]
+            self._worker_versions[worker] = v
+        for worker, wrefs in pending.items():
+            for status in ray_tpu.get(wrefs):
+                if isinstance(status, dict) \
+                        and status.get("status") == "stale":
+                    self.num_stale_fallbacks += 1
+                    metrics.inc("weight_sync_stale_fallbacks")
+                    ray_tpu.get([worker.set_weights.remote(r)
+                                 for r in self._full_refs()])
+                    self._worker_versions[worker] = v
+
+    def forget(self, worker) -> None:
+        """Drop a worker's version (dead or recreated worker)."""
+        self._worker_versions.pop(worker, None)
+
+    def stats(self) -> dict:
+        return {
+            "weight_sync_version": self.encoder.version,
+            "weight_sync_codec": self.encoder.codec,
+            "weight_sync_shards": self.encoder.shard_count,
+            "num_weight_sync_skipped": self.num_skipped,
+            "num_weight_sync_stale_fallbacks": self.num_stale_fallbacks,
+        }

@@ -87,6 +87,19 @@ class JaxRunner:
         n_dev = self.mesh.devices.size
         self._repl = mesh_lib.replicated(self.mesh)
         self._bshard = mesh_lib.batch_sharded(self.mesh)
+        # Param/opt-state layout resolves through the shared SpecLayout
+        # rule table (config "param_sharding" -> RAY_TPU_PARAM_SHARDING;
+        # same layer jax_policy uses). Distributed mode keeps the
+        # replicated layout: its globals assemble from process-local
+        # copies.
+        from ray_tpu._private import spec_layout
+        table = self.config.get("param_sharding")
+        self.layout = spec_layout.SpecLayout.from_config(
+            self.mesh, None if table in (None, "auto") else table)
+        if self.distributed and not self.layout.is_replicated():
+            raise ValueError(
+                "param_sharding tables other than 'replicate' are not "
+                "supported with use_jax_distributed yet")
 
         self.model = self.model_creator(self.config)
         self.optimizer = self.optimizer_creator(self.config)
@@ -116,10 +129,13 @@ class JaxRunner:
             self.params = self._put_repl_global(host_params)
             self.opt_state = self._put_repl_global(
                 self.optimizer.init(host_params))
+            self._param_sh = self._opt_sh = self._repl
         else:
-            self.params = mesh_lib.put_replicated(host_params, self.mesh)
-            self.opt_state = mesh_lib.put_replicated(
-                self.optimizer.init(self.params), self.mesh)
+            host_opt = self.optimizer.init(host_params)
+            self._param_sh = self.layout.shardings(host_params)
+            self._opt_sh = self.layout.shardings(host_opt)
+            self.params = jax.device_put(host_params, self._param_sh)
+            self.opt_state = jax.device_put(host_opt, self._opt_sh)
 
         def train_step(params, opt_state, x, y):
             def batch_loss(p):
@@ -132,12 +148,14 @@ class JaxRunner:
             return params, opt_state, loss
 
         # Donated params/opt + dp-sharded batch: XLA inserts the gradient
-        # all-reduce over the mesh (ICI), replacing NCCL.
+        # all-reduce over the mesh (ICI), replacing NCCL. Params/opt
+        # take the layout-resolved shardings (replicated by default;
+        # fsdp shards the weight update across the mesh).
         self._train_step = jax.jit(
             train_step, donate_argnums=(0, 1),
-            in_shardings=(self._repl, self._repl, self._bshard,
+            in_shardings=(self._param_sh, self._opt_sh, self._bshard,
                           self._bshard),
-            out_shardings=(self._repl, self._repl, self._repl))
+            out_shardings=(self._param_sh, self._opt_sh, self._repl))
 
         def grad_step(params, x, y):
             def batch_loss(p):
@@ -148,7 +166,7 @@ class JaxRunner:
 
         self._grad_step = jax.jit(
             grad_step,
-            in_shardings=(self._repl, self._bshard, self._bshard),
+            in_shardings=(self._param_sh, self._bshard, self._bshard),
             out_shardings=(self._repl, self._repl))
 
         def eval_step(params, x, y):
@@ -157,7 +175,7 @@ class JaxRunner:
 
         self._eval_step = jax.jit(
             eval_step,
-            in_shardings=(self._repl, self._bshard, self._bshard),
+            in_shardings=(self._param_sh, self._bshard, self._bshard),
             out_shardings=self._repl)
         self._perm_rng = np.random.RandomState(
             self.config.get("seed", 0) + self.world_rank)
@@ -288,7 +306,30 @@ class JaxRunner:
         if getattr(self, "distributed", False):
             self.params = self._put_repl_global(weights)
         else:
-            self.params = mesh_lib.put_replicated(weights, self.mesh)
+            self.params = jax.device_put(weights, self._param_sh)
+
+    # -- sharded weight exchange (the cross-replica update sharding) ----
+    def get_weights_shard(self, shard_index: int, shard_count: int):
+        """One equal byte-range slice of the flattened f32 parameter
+        vector (spec_layout.shard_bounds semantics) — the unit the
+        sharded averaging step moves, so no process ever gathers the
+        full N-replica weight stack."""
+        from ray_tpu._private import weight_sync
+        from ray_tpu._private.spec_layout import shard_bounds
+        vec, _aux = weight_sync.flatten_f32(self.get_weights())
+        start, stop = shard_bounds(vec.size, shard_count)[shard_index]
+        return vec[start:stop]
+
+    def apply_weights_shard(self, shard_index: int, shard_count: int,
+                            shard_vec) -> None:
+        """Overwrite one shard slice with the averaged values."""
+        from ray_tpu._private import weight_sync
+        from ray_tpu._private.spec_layout import shard_bounds
+        host = self.get_weights()
+        vec, aux = weight_sync.flatten_f32(host)
+        start, stop = shard_bounds(vec.size, shard_count)[shard_index]
+        vec[start:stop] = np.asarray(shard_vec, np.float32)
+        self.set_weights(weight_sync.unflatten_f32(host, vec, aux))
 
     def get_state(self) -> Dict:
         return {"params": self.get_weights(),
@@ -300,8 +341,9 @@ class JaxRunner:
         if getattr(self, "distributed", False):
             self.opt_state = self._put_repl_global(state["opt_state"])
         else:
-            self.opt_state = mesh_lib.put_replicated(
-                jax.tree.map(jnp.asarray, state["opt_state"]), self.mesh)
+            self.opt_state = jax.device_put(
+                jax.tree.map(jnp.asarray, state["opt_state"]),
+                self._opt_sh)
         self.epoch = state["epoch"]
 
     def ping(self):
@@ -326,13 +368,23 @@ class JaxTrainer:
                  batch_size: int = 64,
                  num_devices_per_replica: int = 0,
                  use_jax_distributed: bool = False,
-                 runner_env: Optional[dict] = None):
+                 runner_env: Optional[dict] = None,
+                 weight_sync_shards: Optional[int] = None):
         self._ctor_args = (model_creator, data_creator, optimizer_creator,
                            loss_creator)
         self.config = dict(config or {})
         self.batch_size = batch_size
         self.num_replicas = num_replicas
         self.num_devices_per_replica = num_devices_per_replica
+        # Sharded synchronous averaging: with S > 1 the flattened f32
+        # weight vector averages/broadcasts in S independent slices, so
+        # the driver holds one slice-stack at a time instead of every
+        # replica's full tree at once (PAPERS: "Automatic Cross-Replica
+        # Sharding of Weight Update in Data-Parallel Training").
+        if weight_sync_shards is None:
+            from ray_tpu._private import config as config_mod
+            weight_sync_shards = config_mod.get("RAY_TPU_WEIGHT_SHARDS")
+        self.weight_sync_shards = max(1, int(weight_sync_shards))
         # jax.distributed mode: runners form ONE global device world;
         # gradient all-reduce happens inside XLA across processes (DCN)
         # instead of through the object store.
@@ -413,11 +465,30 @@ class JaxTrainer:
         return out
 
     def _average_weights(self):
+        if self.weight_sync_shards > 1 and len(self.runners) > 1:
+            self._average_weights_sharded()
+            return
         all_w = ray_tpu.get([r.get_weights.remote() for r in self.runners])
         mean_w = jax.tree.map(
             lambda *xs: np.mean(np.stack(xs), axis=0), *all_w)
         ref = ray_tpu.put(mean_w)
         ray_tpu.get([r.set_weights.remote(ref) for r in self.runners])
+
+    def _average_weights_sharded(self):
+        """Per-shard synchronous averaging: shard i gathers, averages,
+        and broadcasts independently — peak driver residency is one
+        slice-stack (total/S x replicas) instead of the whole tree from
+        every replica, and every broadcast object is 1/S of the blob."""
+        from ray_tpu._private import metrics
+        S = self.weight_sync_shards
+        for i in range(S):
+            slices = ray_tpu.get(
+                [r.get_weights_shard.remote(i, S) for r in self.runners])
+            mean_slice = np.mean(np.stack(slices), axis=0)
+            metrics.inc("weight_sync_bytes", int(mean_slice.nbytes))
+            ref = ray_tpu.put(mean_slice)
+            ray_tpu.get([r.apply_weights_shard.remote(i, S, ref)
+                         for r in self.runners])
 
     def _recover(self):
         if self.use_jax_distributed:
