@@ -262,17 +262,36 @@ def bench_anakin(n_dev: int, flops_per_step: float = 0.0):
     return med, stddev_pct, reward, mfu, telemetry
 
 
+# Latency histograms whose tails ride into BENCH json (the tail plane's
+# r09+ trajectory lines: median vs p99 is the straggler story).
+TAIL_HISTS = ("get_wall_s", "put_wall_s", "task_exec_s",
+              "task_queue_wait_s", "weight_sync_encode_s",
+              "weight_sync_apply_s", "wire_chunk_send_s")
+
+
 def snapshot_cluster_metrics():
     """Aggregated cluster counters/gauges (incl. the train_* telemetry)
-    captured while the runtime is still up, so BENCH json carries the
-    observability plane's view alongside the throughput numbers."""
+    and p50/p95/p99 latency tails, captured while the runtime is still
+    up, so BENCH json carries the observability plane's view alongside
+    the throughput numbers."""
     import ray_tpu
     try:
         agg = ray_tpu.cluster_metrics()
+        tails = {}
+        for name in TAIL_HISTS:
+            q = (agg.get("quantiles") or {}).get(name)
+            if q and q.get("count"):
+                tails[name] = {
+                    "count": round(q["count"], 1),
+                    "p50": round(q["p50"], 6),
+                    "p95": round(q["p95"], 6),
+                    "p99": round(q["p99"], 6),
+                    "max": round(q["max"], 6)}
         return {"counters": {k: round(v, 3)
                              for k, v in sorted(agg["counters"].items())},
                 "gauges": {k: round(v, 6)
-                           for k, v in sorted(agg["gauges"].items())}}
+                           for k, v in sorted(agg["gauges"].items())},
+                "latency_tails": tails}
     except Exception:
         return None
 
@@ -438,6 +457,8 @@ def bench_sebulba(n_dev: int, env: str, obs_delta, n_actors: int,
     # zero broadcast bytes by design — so this records the architecture
     # dividend, and goes nonzero on remote-worker runs.
     snap = snapshot_cluster_metrics() or {"counters": {}}
+    # Tail latencies (p50/p95/p99) of the paths this arm exercises.
+    acct["latency_tails"] = snap.get("latency_tails") or {}
     updates = max(1, opt.num_steps_trained // max(1, n_envs * frag))
     acct["weight_sync_bytes_per_update"] = round(
         snap["counters"].get("weight_sync_bytes", 0) / updates, 1)

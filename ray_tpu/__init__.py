@@ -90,10 +90,43 @@ def init(num_cpus: _Optional[float] = None,
         _LOCAL_RUNTIME = LocalRuntime()
         _ws.set_runtime(_LOCAL_RUNTIME, _ws.LOCAL_MODE)
         return _LOCAL_RUNTIME
-    return _node.init(resources=resources, num_cpus=num_cpus,
-                      num_tpus=num_tpus,
-                      num_initial_workers=num_initial_workers,
-                      worker_env=worker_env, address=address)
+    rt = _node.init(resources=resources, num_cpus=num_cpus,
+                    num_tpus=num_tpus,
+                    num_initial_workers=num_initial_workers,
+                    worker_env=worker_env, address=address)
+    from ._private import config as _config
+    if _config.get("RAY_TPU_FLIGHT_RECORDER"):
+        _install_flight_recorder_hook()
+    return rt
+
+
+_FLIGHT_HOOK_INSTALLED = False
+
+
+def _install_flight_recorder_hook():
+    """Chain a sys.excepthook that writes the flight-recorder bundle
+    before a driver-fatal error kills the process — the postmortem of
+    record when nobody was watching the dashboard. Fires at most once
+    per process; a failure to dump never masks the original error."""
+    global _FLIGHT_HOOK_INSTALLED
+    if _FLIGHT_HOOK_INSTALLED:
+        return
+    _FLIGHT_HOOK_INSTALLED = True
+    import sys as _sys
+    prev_hook = _sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        try:
+            path = debug_dump()
+            _sys.stderr.write(
+                f"ray_tpu: flight recorder dump written to {path} "
+                f"(pretty-print: python -m ray_tpu.scripts dump "
+                f"{path})\n")
+        except Exception:
+            pass
+        prev_hook(exc_type, exc, tb)
+
+    _sys.excepthook = _hook
 
 
 def shutdown():
@@ -278,11 +311,34 @@ def cluster_info() -> dict:
 
 
 def cluster_metrics() -> dict:
-    """Cluster-aggregated metric counters/gauges (parity: the
-    reference's Prometheus metrics plane, `src/ray/stats/`). Also
-    exposed via `ray_tpu stat --metrics` and, when RAY_TPU_METRICS_PORT
-    is set, as Prometheus text on http://127.0.0.1:<port>/metrics."""
+    """Cluster-aggregated metric counters/gauges/histograms (parity:
+    the reference's Prometheus metrics plane, `src/ray/stats/`). The
+    aggregate carries `quantiles` (p50/p95/p99 per histogram) and
+    `rates` (trailing-window counter rates from the head's rate ring).
+    Also exposed via `ray_tpu stat --metrics` / `--rates` and, when
+    RAY_TPU_METRICS_PORT is set, as Prometheus text on
+    http://127.0.0.1:<port>/metrics."""
     return _ws.get_runtime().cluster_metrics()
+
+
+def cluster_rates() -> dict:
+    """Trailing-window per-second rates of every cluster counter
+    (tasks/s, wire bytes/s, weight syncs/s, ...), computed from the
+    head's bounded rate ring of periodic counter snapshots — live
+    activity instead of lifetime totals. Window and cadence are the
+    RAY_TPU_RATE_WINDOW_S / RAY_TPU_RATE_RING_INTERVAL_S knobs."""
+    return _ws.get_runtime().cluster_rates()
+
+
+def debug_dump(path: _Optional[str] = None) -> str:
+    """Flight recorder: write one postmortem JSON bundling the task-
+    ring tail, the metrics + histogram aggregate, recent profiling
+    spans, and per-node health. Returns the written path (default:
+    RAY_TPU_FLIGHT_RECORDER_PATH or <session>/logs/flight_recorder
+    .json). Installed automatically on driver-fatal errors when
+    RAY_TPU_FLIGHT_RECORDER is on; pretty-print with
+    `python -m ray_tpu.scripts dump <path>`."""
+    return _ws.get_runtime().debug_dump(path)
 
 
 __all__ = [
@@ -290,7 +346,8 @@ __all__ = [
     "ActorUnavailableError", "GetTimeoutError",
     "ObjectLostError", "ObjectRef", "RayActorError", "RayError",
     "RayTaskError", "TaskError", "WorkerCrashedError", "available_resources",
-    "cluster_info", "cluster_metrics", "cluster_resources", "exceptions",
+    "cluster_info", "cluster_metrics", "cluster_rates",
+    "cluster_resources", "debug_dump", "exceptions",
     "exit_actor", "free",
     "get", "get_actor", "init", "is_initialized", "kill", "method",
     "profile", "put", "remote", "shutdown", "task_summary", "tasks",

@@ -90,6 +90,13 @@ SITES: Dict[str, Dict[str, str]] = {
                  "delta applies (restarted worker / evicted base; "
                  "decode reports stale and the sender full-syncs)",
     },
+    "actor.sample": {
+        "delay": "sleep <param> seconds before a rollout actor's next "
+                 "sample fragment; param '<tag>@<seconds>' targets one "
+                 "actor (e.g. a1@0.25 slows only inline actor a1 — the "
+                 "straggler-detector chaos drill), bare seconds slow "
+                 "every actor",
+    },
     "exec.before": {
         "kill": "kill the worker process before the task body runs",
     },
@@ -116,8 +123,8 @@ class ChaosSpecError(ValueError):
 
 
 class _Rule:
-    __slots__ = ("site", "kind", "trigger", "value", "param", "spec",
-                 "_rng", "_once_name")
+    __slots__ = ("site", "kind", "trigger", "value", "param", "target",
+                 "spec", "_rng", "_once_name")
 
     def __init__(self, site: str, kind: str, trigger: str, value: float,
                  param: Optional[str], seed: int, spec: str):
@@ -126,6 +133,12 @@ class _Rule:
         self.trigger = trigger  # 'n' | 'every' | 'p' | 'once'
         self.value = value
         self.param = param
+        # '<target>@<value>' params scope the rule to occurrences whose
+        # detail equals the target (e.g. actor.sample:delay:every1:a1@.2
+        # slows only inline actor a1).
+        self.target = None
+        if param and "@" in str(param):
+            self.target = str(param).split("@", 1)[0]
         self.spec = spec
         import random
         self._rng = random.Random(
@@ -158,10 +171,19 @@ class _Rule:
         except OSError:
             return True  # unwritable dir: prefer injecting over skipping
 
+    def applies_to(self, detail: str) -> bool:
+        """Detail filter for targeted rules. Checked AFTER matches() so
+        every rule's rng stream advances once per occurrence regardless
+        of detail — the invariant seeded replay depends on."""
+        return self.target is None or str(detail) == self.target
+
     @property
     def delay(self) -> float:
+        param = self.param
+        if param and "@" in str(param):
+            param = str(param).split("@", 1)[1]
         try:
-            return float(self.param) if self.param else 0.05
+            return float(param) if param else 0.05
         except ValueError:
             return 0.05
 
@@ -243,7 +265,7 @@ class ChaosController:
             self._counts[site] = occ
             fired = None
             for rule in rules:
-                if rule.matches(occ):
+                if rule.matches(occ) and rule.applies_to(detail):
                     fired = rule
                     break
             if fired is None:

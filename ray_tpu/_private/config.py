@@ -138,6 +138,33 @@ _def("RAY_TPU_LOG_LEVEL", str, "WARNING",
 _def("RAY_TPU_TASK_LOG_MAX", int, 4096,
      "Task-lifecycle records retained in the head's bounded ring "
      "(ray_tpu.tasks() / task_summary() / stat --tasks)")
+_def("RAY_TPU_RATE_RING_INTERVAL_S", float, 2.0,
+     "Head rate-ring sampling period: each tick appends a (timestamp, "
+     "cluster counter totals) slot the trailing-window rates in `stat "
+     "--rates` and the dashboard are computed from (0 disables)")
+_def("RAY_TPU_RATE_RING_SLOTS", int, 150,
+     "Rate-ring capacity (bounded deque of counter snapshots; 150 "
+     "slots x 2s default interval = a 5-minute history)")
+_def("RAY_TPU_RATE_WINDOW_S", float, 30.0,
+     "Trailing window rates are computed over: newest ring slot vs the "
+     "oldest slot still inside the window")
+_def("RAY_TPU_STRAGGLER_K", float, 3.0,
+     "Straggler detector outlier threshold in robust sigmas: an actor "
+     "whose throughput or fetch latency sits more than k sigma (MAD-"
+     "scaled) below/above the fleet median is flagged "
+     "(straggler_flags_total, task annotations, trainer results)")
+_def("RAY_TPU_STRAGGLER_MIN_PEERS", int, 3,
+     "Minimum fleet size before the straggler detector renders "
+     "verdicts (a median over 2 actors flags coin flips)")
+_def("RAY_TPU_FLIGHT_RECORDER", bool, True,
+     "Install the driver-fatal excepthook that writes a flight-"
+     "recorder postmortem (task-ring tail + metrics/histograms + "
+     "recent spans + node health) before the driver dies; "
+     "ray_tpu.debug_dump() works regardless")
+_def("RAY_TPU_FLIGHT_RECORDER_PATH", str, None,
+     "Flight-recorder output path (default: "
+     "<session_dir>/logs/flight_recorder.json); pretty-print with "
+     "`ray_tpu.scripts dump <path>`")
 
 # --- actors -----------------------------------------------------------
 _def("RAY_TPU_NUM_ACTOR_CHECKPOINTS_TO_KEEP", int, 20,

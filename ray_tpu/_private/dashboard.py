@@ -29,6 +29,7 @@ pre {{ background: #111; color: #ddd; padding: 8px; max-height: 20em;
 <h1>ray_tpu — session {session}</h1>
 <p>{now} &middot; {n_nodes} node(s) &middot; {n_actors} actor(s)
 &middot; tasks: {task_states}</p>
+<h2>Rates</h2>{rates}
 <h2>Nodes</h2>{nodes}
 <h2>Tasks</h2>{tasks}
 <h2>Actors</h2>{actors}
@@ -68,6 +69,14 @@ def render(head) -> str:
         or "(none)"
     agg = head._aggregated_metrics()
     per_node = agg.get("per_node") or {}
+    rates = agg.get("rates") or {}
+    rate_rows = [(html.escape(k), f"{v:.4g}/s")
+                 for k, v in sorted(rates.items())
+                 if ("task" in k or "bytes" in k or "sync" in k
+                     or "straggler" in k)]
+    if not rate_rows:  # young ring: show whatever moved
+        rate_rows = [(html.escape(k), f"{v:.4g}/s")
+                     for k, v in sorted(rates.items())]
     store_rows = [
         (html.escape(k), "total", f"{v:g}") for k, v in sorted(
             agg.get("gauges", {}).items())
@@ -116,6 +125,7 @@ def render(head) -> str:
         now=time.strftime("%Y-%m-%d %H:%M:%S"),
         n_nodes=len(nodes), n_actors=len(actors),
         task_states=task_states,
+        rates=_table(("counter", "rate"), rate_rows),
         nodes=_table(
             ("node", "state", "total", "available", "mem"), node_rows),
         tasks=_table(

@@ -209,6 +209,13 @@ class HeadServer:
         # die with the process).
         self._dead_counters: Dict[str, Dict[str, float]] = {}
         self._metrics_http = None
+        # Rate ring: bounded trailing window of (ts, counter totals)
+        # snapshots the monitor loop appends, so rates() can report
+        # tasks/s / wire bytes/s deltas instead of lifetime totals.
+        self._rate_ring: deque = deque(
+            maxlen=max(2, config.get("RAY_TPU_RATE_RING_SLOTS")))
+        self._rate_interval = config.get("RAY_TPU_RATE_RING_INTERVAL_S")
+        self._rate_last_sample = 0.0
 
         self.server = protocol.Server(
             self.sock_path, self._handle, on_connect=self._on_connect,
@@ -429,6 +436,8 @@ class HeadServer:
                 "node": msg.get("node", ""),
                 "counters": msg.get("counters") or {},
                 "gauges": msg.get("gauges") or {},
+                "hists": msg.get("hists") or {},
+                "rollups": msg.get("rollups") or {},
             }
 
     def _aggregated_metrics(self) -> dict:
@@ -455,10 +464,92 @@ class HeadServer:
         agg = metrics_mod.aggregate(snaps)
         # Head-derived quantities are point-in-time gauges.
         agg["gauges"].update(head_counters)
+        agg["rates"] = self.rates()
         return agg
 
     def _h_get_metrics(self, conn, msg):
         conn.reply(msg, metrics=self._aggregated_metrics())
+
+    # -- rate ring: trailing-window rates from counter deltas ------------
+    def _sample_rate_ring(self):
+        """Append one (monotonic ts, cluster counter totals) slot. Driven
+        by the monitor loop on the RAY_TPU_RATE_RING_INTERVAL_S cadence;
+        rates() reads deltas off the ring, so `stat --rates` and the
+        dashboard report tasks/s and wire bytes/s over a trailing window
+        instead of lifetime totals."""
+        from . import metrics as metrics_mod
+        with self._lock:
+            snaps = dict(self._metric_snaps)
+            for node, dead in self._dead_counters.items():
+                snaps[f"__dead__{node}"] = {
+                    "node": node, "counters": dict(dead)}
+        counters: Dict[str, float] = {}
+        for snap in snaps.values():
+            for k, v in (snap.get("counters") or {}).items():
+                counters[k] = counters.get(k, 0.0) + v
+        with self._lock:
+            self._rate_ring.append((time.monotonic(), counters))
+
+    def rates(self, window_s: Optional[float] = None) -> Dict[str, float]:
+        """Per-second rate of every cluster counter over the trailing
+        window (newest ring slot vs the oldest slot still inside the
+        window). Counters fold monotonically — dead-process totals move
+        into _dead_counters, never shrink — so deltas are >= 0."""
+        if window_s is None:
+            window_s = config.get("RAY_TPU_RATE_WINDOW_S")
+        with self._lock:
+            ring = list(self._rate_ring)
+        if len(ring) < 2:
+            return {}
+        now_ts, now_counters = ring[-1]
+        base_ts, base_counters = ring[0]
+        for ts, counters in ring[:-1]:
+            if now_ts - ts <= window_s:
+                base_ts, base_counters = ts, counters
+                break
+        dt = now_ts - base_ts
+        if dt <= 0:
+            return {}
+        out = {}
+        for k, v in now_counters.items():
+            delta = v - base_counters.get(k, 0.0)
+            if delta > 0:
+                out[k] = delta / dt
+        return out
+
+    # -- flight recorder (postmortem bundle; scripts dump) ---------------
+    def debug_dump_data(self) -> dict:
+        """One JSON-serializable postmortem: task-ring tail, metrics +
+        histogram aggregate, recent spans, per-node health. The bundle
+        `ray_tpu.debug_dump()` and the driver-fatal excepthook write."""
+        agg = self._aggregated_metrics()
+        now = time.monotonic()
+        with self._lock:
+            nodes = [{
+                "node_id": n.node_id,
+                "alive": n.alive,
+                "resources": dict(n.total),
+                "available": dict(n.available),
+                "heartbeat_age_s": (now - n.last_heartbeat)
+                if n.conn is not None else None,
+            } for n in self._nodes.values()]
+            workers = len(self._workers)
+            spans = list(self._profile_events[-500:])
+            errors = list(self._recent_errors)
+        return {
+            "ts": time.time(),
+            "session_dir": self.session_dir,
+            "metrics": agg,
+            "tasks": self._task_log.list(limit=200),
+            "task_state_counts": self._task_log.state_counts(),
+            "spans": spans,
+            "nodes": nodes,
+            "workers_registered": workers,
+            "recent_errors": errors,
+        }
+
+    def _h_debug_dump(self, conn, msg):
+        conn.reply(msg, dump=self.debug_dump_data())
 
     def _start_metrics_http(self, port: int):
         import http.server
@@ -1234,6 +1325,10 @@ class HeadServer:
             dead: List[WorkerInfo] = []
             stale_nodes: List[NodeInfo] = []
             now = time.monotonic()
+            if self._rate_interval > 0 \
+                    and now - self._rate_last_sample >= self._rate_interval:
+                self._rate_last_sample = now
+                self._sample_rate_ring()
             with self._lock:
                 for w in self._spawned.values():
                     if w.proc is not None and w.proc.poll() is not None \

@@ -158,19 +158,25 @@ class _StripeWorker:
                 self.alive = False
                 ticket.fail(item, e)
                 # Hand back everything already queued behind the failure.
-                while True:
-                    try:
-                        it = self.q.get_nowait()
-                    except queue.Empty:
-                        break
-                    if it is not None:
-                        it[0].fail(it, e)
+                self.drain_dead(e)
                 if self.owns_conn:
                     try:
                         self.conn.close()
                     except Exception:
                         pass
                 return
+
+    def drain_dead(self, exc: BaseException):
+        """Fail every item still queued on a dead worker back to its
+        ticket. Safe to race with the worker's own drain: Queue.get is
+        atomic, so each item is accounted exactly once."""
+        while True:
+            try:
+                it = self.q.get_nowait()
+            except queue.Empty:
+                return
+            if it is not None:
+                it[0].fail(it, exc)
 
     def stop(self, join_timeout: float = 0.0):
         self.alive = False
@@ -183,6 +189,9 @@ class _StripeWorker:
                 self.conn.close()
             except Exception:
                 pass
+        # Items parked behind the sentinel would otherwise be lost with
+        # their tickets' dispatch counts forever in flight.
+        self.drain_dead(protocol.ConnectionClosed("transfer pool closed"))
         if join_timeout > 0 \
                 and self.thread is not threading.current_thread():
             self.thread.join(timeout=join_timeout)
@@ -310,6 +319,7 @@ class _TransferPool:
                     else 0.8 * self.ema_mbps + 0.2 * mbps
         metrics_mod.inc("wire_bytes_on_wire", wire_n)
         metrics_mod.inc("wire_bytes_raw", raw_n)
+        metrics_mod.observe("wire_chunk_send_s", dt)
         if codec != serialization.WIRE_RAW:
             metrics_mod.inc("wire_bytes_saved", max(0, raw_n - wire_n))
             metrics_mod.inc("wire_chunks_compressed")
@@ -333,9 +343,19 @@ class _TransferPool:
             best = min(workers, key=lambda w: w.q.qsize())
             try:
                 best.q.put(item, timeout=0.2)
-                return
             except queue.Full:
                 continue  # re-pick: load or liveness changed
+            if best.alive:
+                return
+            # The worker died between the liveness check and the put:
+            # its failure handler may have drained the queue before our
+            # item landed, leaving it unaccounted — drain_failures()
+            # would then wait forever. Reclaim whatever is still queued;
+            # every reclaimed item lands in its ticket's failed list for
+            # redispatch.
+            best.drain_dead(protocol.ConnectionClosed(
+                "stripe stream died during dispatch"))
+            return
 
     def send_object(self, oid, parts, total: int, num: int) -> dict:
         """Stream one object's serialized bytes to the peer. `parts`
@@ -1069,6 +1089,11 @@ class Runtime:
     # object API
     # ==================================================================
     def put(self, value) -> ObjectRef:
+        from . import metrics as metrics_mod
+        with metrics_mod.timer("put_wall_s"):
+            return self._put(value)
+
+    def _put(self, value) -> ObjectRef:
         if isinstance(value, ObjectRef):
             raise TypeError("put() of an ObjectRef is not allowed")
         oid = ObjectID.generate()
@@ -1239,16 +1264,19 @@ class Runtime:
                 f"(RAY_TPU_EVICTION_GRACE_S={self._eviction_grace:g}s)")
 
     def get(self, refs, timeout: Optional[float] = None):
+        from . import metrics as metrics_mod
         single = isinstance(refs, ObjectRef)
         if single:
             refs = [refs]
         deadline = None if timeout is None else time.monotonic() + timeout
-        if len(refs) > 1:
-            # Issue owner fetches for every pending foreign ref up
-            # front (bounded by the prefetch pool) so transfers overlap
-            # instead of serializing through the one-at-a-time loop.
-            self._prefetch(refs)
-        values = [self._get_one(r, deadline) for r in refs]
+        with metrics_mod.timer("get_wall_s"):
+            if len(refs) > 1:
+                # Issue owner fetches for every pending foreign ref up
+                # front (bounded by the prefetch pool) so transfers
+                # overlap instead of serializing through the
+                # one-at-a-time loop.
+                self._prefetch(refs)
+            values = [self._get_one(r, deadline) for r in refs]
         return values[0] if single else values
 
     def _fetch_submit(self, ref: ObjectRef) -> bool:
@@ -2454,7 +2482,9 @@ class Runtime:
                 self.head.send({"kind": "metrics_push",
                                 "node": self.node_id,
                                 "counters": snap["counters"],
-                                "gauges": snap["gauges"]})
+                                "gauges": snap["gauges"],
+                                "hists": snap["hists"],
+                                "rollups": snap["rollups"]})
             except protocol.ConnectionClosed:
                 return
             except Exception:
@@ -2464,6 +2494,43 @@ class Runtime:
         self.profiler.flush()
         return self.head.request({"kind": "get_profile_events"},
                                  timeout=30)["events"]
+
+    def cluster_rates(self) -> dict:
+        """Trailing-window per-second counter rates from the head's
+        rate ring (`stat --rates`)."""
+        return self.cluster_metrics().get("rates") or {}
+
+    def debug_dump(self, path: Optional[str] = None) -> str:
+        """Flight recorder: fetch the head's postmortem bundle (task-
+        ring tail, metrics + histogram aggregate, recent spans, per-node
+        health) and write it as one JSON file. Returns the path."""
+        import json
+        # Freshen everything this process knows before the head builds
+        # the bundle — a postmortem with a 2s-stale metrics plane would
+        # miss the samples of the failure itself.
+        self.task_events.flush()
+        self.profiler.flush()
+        try:
+            from . import metrics as metrics_mod
+            snap = metrics_mod.snapshot()
+            self.head.send({"kind": "metrics_push",
+                            "node": self.node_id,
+                            "counters": snap["counters"],
+                            "gauges": snap["gauges"],
+                            "hists": snap["hists"],
+                            "rollups": snap["rollups"]})
+        except Exception:
+            pass
+        dump = self.head.request({"kind": "debug_dump"},
+                                 timeout=30)["dump"]
+        if path is None:
+            path = config.get("RAY_TPU_FLIGHT_RECORDER_PATH") \
+                or os.path.join(self.session_dir, "logs",
+                                "flight_recorder.json")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump(dump, f, indent=1, default=str)
+        return path
 
     def profile_dump(self) -> dict:
         """Spans plus the cluster-wide dropped-span count (the timeline
@@ -3270,7 +3337,9 @@ class Runtime:
                 self.head.send({"kind": "metrics_push",
                                 "node": self.node_id,
                                 "counters": snap["counters"],
-                                "gauges": snap["gauges"]})
+                                "gauges": snap["gauges"],
+                                "hists": snap["hists"],
+                                "rollups": snap["rollups"]})
                 time.sleep(0.05)  # let the frame leave the socket
             except Exception:
                 pass

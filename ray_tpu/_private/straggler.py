@@ -1,0 +1,126 @@
+"""Straggler detection: robust fleet-median outlier flagging.
+
+Podracer-style fleets (PAPERS: "Podracer architectures for scalable
+RL") live or die on spotting the slow actor: one delayed rollout worker
+drags every batch barrier while the mean throughput still looks
+healthy. This module renders per-actor verdicts from two signals the
+optimizer already tracks — sampling throughput and fetch latency —
+against the FLEET MEDIAN with a MAD-scaled sigma, so one straggler
+cannot drag the baseline toward itself the way a mean/stddev test
+would (with 1 slow actor of 4, the slow actor inflates the stddev it
+is judged against; the median absolute deviation stays anchored on the
+healthy majority).
+
+An actor is flagged when
+
+    throughput   <  median - k * sigma      (too slow), or
+    fetch latency >  median + k * sigma     (too blocked)
+
+with sigma = 1.4826 * MAD (the normal-consistency constant), floored at
+a fraction of the median so a fleet of identical actors (MAD = 0) still
+flags a genuinely divergent one instead of dividing by zero.
+
+Consumers (rllib/optimizers/async_samples_optimizer.py): verdicts bump
+`straggler_flags_total` (+ a per-actor `straggler_flags.<tag>` series),
+annotate the flagged worker's task records via task_events.ANNOTATE,
+and ride the optimizer's stats() into the trainer's iteration results
+(`result["stragglers"]`). k and the minimum fleet size are the
+RAY_TPU_STRAGGLER_K / RAY_TPU_STRAGGLER_MIN_PEERS knobs.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# MAD -> sigma consistency constant for a normal distribution.
+MAD_SIGMA = 1.4826
+# sigma floor as a fraction of |median|: identical fleets (MAD = 0)
+# still flag an actor deviating by more than k * floor * median.
+SIGMA_FLOOR_FRAC = 0.05
+
+
+def median(values: List[float]) -> float:
+    s = sorted(values)
+    n = len(s)
+    mid = n // 2
+    return s[mid] if n % 2 else 0.5 * (s[mid - 1] + s[mid])
+
+
+def robust_sigma(values: List[float], med: Optional[float] = None) -> float:
+    if med is None:
+        med = median(values)
+    mad = median([abs(v - med) for v in values])
+    return max(MAD_SIGMA * mad, SIGMA_FLOOR_FRAC * abs(med))
+
+
+class StragglerDetector:
+    """Stateless per-window verdicts + cumulative per-actor flag counts.
+
+    `update()` takes one window's per-actor samples:
+
+        {tag: {"throughput": steps/s, "fetch_latency_s": s-or-None}}
+
+    and returns {tag: verdict} where a verdict carries `flagged`, the
+    `reasons` that tripped ("throughput" / "fetch_latency"), and the
+    fleet baseline it was judged against.
+    """
+
+    def __init__(self, k: Optional[float] = None,
+                 min_peers: Optional[int] = None):
+        from . import config
+        self.k = config.get("RAY_TPU_STRAGGLER_K") if k is None else k
+        self.min_peers = config.get("RAY_TPU_STRAGGLER_MIN_PEERS") \
+            if min_peers is None else min_peers
+        self.flag_counts: Dict[str, int] = {}
+        self.windows = 0
+
+    def update(self, samples: Dict[str, dict]) -> Dict[str, dict]:
+        self.windows += 1
+        out: Dict[str, dict] = {
+            tag: {"flagged": False, "reasons": [],
+                  "throughput": s.get("throughput"),
+                  "fetch_latency_s": s.get("fetch_latency_s")}
+            for tag, s in samples.items()}
+        if len(samples) < max(2, self.min_peers):
+            return out
+
+        thr = {t: s["throughput"] for t, s in samples.items()
+               if s.get("throughput") is not None}
+        if len(thr) >= max(2, self.min_peers):
+            med = median(list(thr.values()))
+            sigma = robust_sigma(list(thr.values()), med)
+            for tag, v in thr.items():
+                out[tag]["throughput_median"] = med
+                if v < med - self.k * sigma:
+                    out[tag]["flagged"] = True
+                    out[tag]["reasons"].append("throughput")
+
+        lat = {t: s["fetch_latency_s"] for t, s in samples.items()
+               if s.get("fetch_latency_s") is not None}
+        if len(lat) >= max(2, self.min_peers):
+            med = median(list(lat.values()))
+            sigma = robust_sigma(list(lat.values()), med)
+            for tag, v in lat.items():
+                out[tag]["fetch_latency_median"] = med
+                if v > med + self.k * sigma:
+                    out[tag]["flagged"] = True
+                    if "fetch_latency" not in out[tag]["reasons"]:
+                        out[tag]["reasons"].append("fetch_latency")
+
+        flagged = [t for t, v in out.items() if v["flagged"]]
+        if flagged:
+            from . import metrics
+            for tag in flagged:
+                self.flag_counts[tag] = self.flag_counts.get(tag, 0) + 1
+                metrics.inc("straggler_flags_total")
+                metrics.inc(f"straggler_flags.{tag}")
+        return out
+
+    def report(self, verdicts: Dict[str, dict]) -> dict:
+        """The stats()/trainer-results view of one window's verdicts."""
+        return {
+            "flagged": sorted(t for t, v in verdicts.items()
+                              if v["flagged"]),
+            "flag_counts": dict(self.flag_counts),
+            "per_actor": verdicts,
+        }

@@ -135,6 +135,10 @@ class TaskStateLog:
                         # ("site:kind"), so per-task recovery latency
                         # is attributable in `ray_tpu.tasks()`.
                         rec.setdefault("chaos", []).append(ev["chaos"])
+                    if ev.get("straggler") is not None:
+                        # Straggler-detector verdict for the actor this
+                        # task ran on (straggler.py): latest wins.
+                        rec["straggler"] = ev["straggler"]
             return
         if state not in _RANK:
             return
@@ -158,6 +162,26 @@ class TaskStateLog:
                              ("error", "error")):
                 if ev.get(src) is not None:
                     rec[dst] = ev[src]
+            observe = state in TERMINAL and not rec.get("_observed")
+            if observe:
+                rec["_observed"] = True
+                events = sorted(rec["events"], key=lambda e: e[1])
+        if observe:
+            # Queue-wait / exec histograms, derived once per task as it
+            # turns terminal (this log lives at the head, so the samples
+            # land in the head process's registry and merge into the
+            # cluster aggregate like any other push). Late events that
+            # flush after the terminal transition refine the record's
+            # durations view but not the histogram — one sample per
+            # task keeps bucket counts equal to task counts.
+            from . import metrics
+            run_ts = next((ts for s, ts in events if s == RUNNING), None)
+            if run_ts is not None:
+                metrics.observe("task_queue_wait_s",
+                                max(0.0, run_ts - events[0][1]))
+                metrics.observe(
+                    "task_exec_s",
+                    max(0.0, float(ev.get("ts") or time.time()) - run_ts))
 
     @staticmethod
     def _view(rec: dict) -> dict:
@@ -169,7 +193,7 @@ class TaskStateLog:
         out = {k: rec[k] for k in ("task_id", "name", "kind", "state",
                                    "node", "worker_pid", "caller",
                                    "parent_task_id", "error")}
-        for k in ("wire_bytes", "transfer_bytes", "chaos"):
+        for k in ("wire_bytes", "transfer_bytes", "chaos", "straggler"):
             if k in rec:
                 out[k] = rec[k]
         out["start"] = events[0][1] if events else None

@@ -260,6 +260,7 @@ class WeightSyncEncoder:
         metrics.inc(f"weight_sync_codec.{payloads[0].codec}")
         metrics.set_gauge("weight_sync_ms", 1e3 * dt)
         metrics.set_gauge("weight_sync_payload_bytes", total)
+        metrics.observe("weight_sync_encode_s", dt)
 
 
 class WeightSyncDecoder:
@@ -279,6 +280,11 @@ class WeightSyncDecoder:
         returned), "partial" (shard applied, more shards outstanding),
         "dup" (already applied), or "stale" (base mismatch — caller
         should request a full sync)."""
+        from . import metrics
+        with metrics.timer("weight_sync_apply_s"):
+            return self._apply(payload)
+
+    def _apply(self, payload: WeightSyncPayload):
         from . import chaos
         if payload.codec == CODEC_FULL:
             vec, aux, _ = _flatten(payload.tree)

@@ -71,12 +71,20 @@ class LearnerThread(threading.Thread):
                 self.stopped = True
 
     def step(self):
+        from ..._private import metrics as metrics_mod
+        t0 = time.perf_counter()
         with self.queue_timer:
             try:
                 batch = self.inqueue.get(timeout=0.5)
             except queue.Empty:
                 return
+        # Phase histograms (one sample per consumed batch — empty-queue
+        # timeouts stay out so the queue-wait distribution reflects
+        # batches, not idle polling).
+        metrics_mod.observe("learner_queue_wait_s",
+                            time.perf_counter() - t0)
         self.learner_queue_size.push(self.inqueue.qsize())
+        t1 = time.perf_counter()
         with self.grad_timer:
             policy = self.local_worker.policy
             if self.sgd_minibatch_size:
@@ -89,8 +97,8 @@ class LearnerThread(threading.Thread):
                 for _ in range(self.num_sgd_iter):
                     stats = policy.learn_on_batch(batch)
             self.stats = stats
+        metrics_mod.observe("learner_grad_s", time.perf_counter() - t1)
         self.weights_updated = True
-        from ..._private import metrics as metrics_mod
         metrics_mod.inc("rllib_steps_trained", batch.count)
         self.outqueue.put(batch.count)
 
@@ -120,13 +128,31 @@ class InlineActorThread(threading.Thread):
         self.steps_sampled = 0  # monotonic; read without lock (int swap)
         self._gauge_last = None
         self._gauge_t0 = time.perf_counter()
+        # Pinned at construction: an actor orphaned by a failed stop()
+        # must not fire occurrences into a controller some LATER
+        # ray_tpu.init(chaos=...) installs — that would perturb the
+        # new session's seeded occurrence streams.
+        from ..._private import chaos
+        self._chaos = chaos.controller
 
     def run(self):
         try:
             while not self.stopped:
+                c = self._chaos
+                if c is not None:
+                    # actor.sample chaos: a targeted delay rule (param
+                    # "a1@0.25") slows exactly one actor — the drill
+                    # the straggler detector must attribute.
+                    rule = c.fire("actor.sample", f"a{self.idx}")
+                    if rule is not None and rule.kind == "delay":
+                        time.sleep(rule.delay)
                 batch = self.sampler.sample()
                 self.steps_sampled += batch.count
-                self._publish_pipeline_gauges()
+                if not self.stopped:
+                    # An actor whose stop/join raced a long in-flight
+                    # sample must not ghost-write the aK gauges of a
+                    # successor trainer's same-tag actor.
+                    self._publish_pipeline_gauges()
                 while not self.stopped:
                     try:
                         self.learner.inqueue.put(batch, timeout=1.0)
@@ -154,18 +180,24 @@ class InlineActorThread(threading.Thread):
             last = self._gauge_last
             from ..._private import metrics as metrics_mod
             tag = f"a{self.idx}"
+            # Mean roll-up: the cluster series must stay a percentage
+            # (4 actors at ~97% read ~97%, not the 387% a sum renders);
+            # per-actor values stay attributable under per_node.
             metrics_mod.set_gauge(
                 f"sebulba_action_fetch_pct.{tag}",
-                100.0 * (stats["t_fetch_s"] - last["t_fetch_s"]) / dt)
+                100.0 * (stats["t_fetch_s"] - last["t_fetch_s"]) / dt,
+                rollup="mean")
             metrics_mod.set_gauge(
                 f"sebulba_env_step_pct.{tag}",
-                100.0 * (stats["t_env_s"] - last["t_env_s"]) / dt)
+                100.0 * (stats["t_env_s"] - last["t_env_s"]) / dt,
+                rollup="mean")
             dsteps = stats["steps"] - last["steps"]
             if dsteps > 0:
                 metrics_mod.set_gauge(
                     f"sebulba_policy_lag_steps.{tag}",
                     (stats.get("policy_lag_sum", 0)
-                     - last.get("policy_lag_sum", 0)) / dsteps)
+                     - last.get("policy_lag_sum", 0)) / dsteps,
+                    rollup="mean")
         if self._gauge_last is None or dt >= 0.5:
             self._gauge_last = stats
             self._gauge_t0 = now
@@ -229,6 +261,19 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         self._inline_actors: List[InlineActorThread] = []
         self._inline_sampled_seen = 0
         self._compiled = False
+        # Straggler detection (straggler.py): per-actor throughput /
+        # fetch-latency windows judged against the fleet median each
+        # stats() call; verdicts ride into trainer results.
+        from ..._private.straggler import StragglerDetector
+        self._straggler = StragglerDetector()
+        self._straggler_report = {}
+        self._strag_prev = {}
+        self._strag_t0 = time.monotonic()
+        self._worker_tags = {}
+        self._worker_sampled = {}
+        self._worker_fetch_s = {}
+        self._worker_fetch_n = {}
+        self._worker_last_task = {}
 
         if num_inline_actors > 0:
             from ..env.registry import make_batched_env
@@ -311,7 +356,8 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
 
         if workers.remote_workers:
             self._broadcast_weights()
-            for w in workers.remote_workers:
+            for i, w in enumerate(workers.remote_workers):
+                self._worker_tags[w] = f"w{i}"
                 for _ in range(self.max_in_flight):
                     self.sample_tasks.add(w, w.sample.remote())
 
@@ -349,9 +395,26 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         `iter_train_batches` + optimizer `_step`)."""
         sampled = 0
         for worker, ref in self.sample_tasks.completed(blocking_wait=True):
+            tag = self._worker_tags.get(worker)
+            tf0 = time.perf_counter()
             batch = ray_tpu.get(ref)
+            fetch_dt = time.perf_counter() - tf0
             decompress_batch(batch)
             sampled += batch.count
+            if tag is not None:
+                # Per-worker throughput / fetch-latency ledger the
+                # straggler detector windows over.
+                self._worker_sampled[tag] = \
+                    self._worker_sampled.get(tag, 0) + batch.count
+                self._worker_fetch_s[tag] = \
+                    self._worker_fetch_s.get(tag, 0.0) + fetch_dt
+                self._worker_fetch_n[tag] = \
+                    self._worker_fetch_n.get(tag, 0) + 1
+                try:
+                    self._worker_last_task[tag] = \
+                        ref.id.task_id().hex()
+                except Exception:
+                    pass
             self._batch_buffer.append(batch)
             self._batch_buffer_count += batch.count
             if self._batch_buffer_count >= self.train_batch_size:
@@ -453,6 +516,59 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         self.learner_stats = self.learner.stats
         return self.learner_stats
 
+    def _update_stragglers(self) -> dict:
+        """Window the per-actor ledgers since the last call, render
+        fleet-median verdicts, and push the side effects: the
+        straggler_flags counters and ANNOTATE marks on the flagged
+        workers' latest task records. Returns the stats()/trainer view
+        (straggler.py module doc)."""
+        now = time.monotonic()
+        dt = now - self._strag_t0
+        if dt < 0.5:
+            return self._straggler_report
+        cum = {}
+        for a in self._inline_actors:
+            tag = f"a{a.idx}"
+            if hasattr(a.sampler, "transfer_stats"):
+                ts = a.sampler.transfer_stats()
+                cum[tag] = {"steps": a.steps_sampled,
+                            "fetch_s": ts.get("t_fetch_s", 0.0),
+                            "fetch_n": ts.get("steps", 0)}
+            else:
+                cum[tag] = {"steps": a.steps_sampled,
+                            "fetch_s": None, "fetch_n": 0}
+        for tag, steps in self._worker_sampled.items():
+            cum[tag] = {"steps": steps,
+                        "fetch_s": self._worker_fetch_s.get(tag, 0.0),
+                        "fetch_n": self._worker_fetch_n.get(tag, 0)}
+        samples = {}
+        for tag, c in cum.items():
+            prev = self._strag_prev.get(
+                tag, {"steps": 0, "fetch_s": 0.0, "fetch_n": 0})
+            sample = {"throughput": (c["steps"] - prev["steps"]) / dt}
+            if c["fetch_s"] is not None:
+                dn = c["fetch_n"] - prev["fetch_n"]
+                if dn > 0:
+                    sample["fetch_latency_s"] = \
+                        (c["fetch_s"] - (prev["fetch_s"] or 0.0)) / dn
+            samples[tag] = sample
+        self._strag_prev = cum
+        self._strag_t0 = now
+        verdicts = self._straggler.update(samples)
+        flagged = [t for t, v in verdicts.items() if v["flagged"]]
+        if flagged:
+            from ..._private import task_events as te
+            from ..._private import worker_state as _ws
+            rt = _ws.get_runtime_or_none()
+            if rt is not None and hasattr(rt, "task_events"):
+                for tag in flagged:
+                    tid = self._worker_last_task.get(tag)
+                    if tid:
+                        rt.task_events.record(tid, te.ANNOTATE,
+                                              straggler=tag)
+        self._straggler_report = self._straggler.report(verdicts)
+        return self._straggler_report
+
     def stats(self) -> dict:
         out = super().stats()
         out.update(self._broadcaster.stats())
@@ -472,6 +588,9 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         if transfer:
             out["transfer"] = {
                 k: sum(t[k] for t in transfer) for k in transfer[0]}
+        stragglers = self._update_stragglers()
+        if stragglers:
+            out["stragglers"] = stragglers
         return out
 
     def stop(self):

@@ -345,6 +345,18 @@ def cmd_stat(args):
                       f"{str(t['worker_pid'] or '-'):<7s} {dur:<9s} "
                       f"{(t['error'] or '')[:40]}")
             return
+        if getattr(args, "rates", False):
+            agg = conn.request({"kind": "get_metrics"},
+                               timeout=30)["metrics"]
+            rates = agg.get("rates") or {}
+            if not rates:
+                print("rates: (no rate-ring window yet — the head "
+                      "samples every RAY_TPU_RATE_RING_INTERVAL_S)")
+                return
+            print("rates (per second, trailing window):")
+            for k, v in sorted(rates.items()):
+                print(f"  {k:<40s} {v:g}/s")
+            return
         if getattr(args, "metrics", False):
             agg = conn.request({"kind": "get_metrics"},
                                timeout=30)["metrics"]
@@ -354,6 +366,17 @@ def cmd_stat(args):
             print("gauges:")
             for k, v in sorted(agg.get("gauges", {}).items()):
                 print(f"  {k:<32s} {v:g}")
+            quantiles = agg.get("quantiles") or {}
+            if quantiles:
+                print("histograms (seconds):")
+                print(f"  {'name':<28s} {'count':>7s} {'p50':>10s} "
+                      f"{'p95':>10s} {'p99':>10s} {'max':>10s}")
+                for k, q in sorted(quantiles.items()):
+                    def _f(x):
+                        return f"{x:.4g}" if x is not None else "-"
+                    print(f"  {k:<28s} {q['count']:>7g} "
+                          f"{_f(q['p50']):>10s} {_f(q['p95']):>10s} "
+                          f"{_f(q['p99']):>10s} {_f(q['max']):>10s}")
             return
         info = conn.request({"kind": "cluster_info"}, timeout=30)["info"]
     finally:
@@ -375,6 +398,57 @@ def cmd_stat(args):
               f"{locs['replicas']} replicas")
         for oid_hex, count in locs.get("top", []):
             print(f"  {oid_hex[:16]:<18s} x{count}")
+
+
+def cmd_dump(args):
+    """Pretty-print a flight-recorder postmortem (`ray_tpu.debug_dump()`
+    or the driver-fatal excepthook wrote it)."""
+    import json
+    with open(args.path) as f:
+        dump = json.load(f)
+    print(f"flight recorder dump — session {dump.get('session_dir')}")
+    print(f"written at: {dump.get('ts')}")
+    print("nodes:")
+    for n in dump.get("nodes") or []:
+        hb = n.get("heartbeat_age_s")
+        hb_s = f"hb_age={hb:.1f}s" if hb is not None else "hb=local"
+        print(f"  {n['node_id']:<10s} alive={n['alive']} {hb_s} "
+              f"avail={n.get('available')}")
+    print(f"workers registered: {dump.get('workers_registered')}")
+    counts = dump.get("task_state_counts") or {}
+    print("task states: " + (" ".join(
+        f"{s}={counts[s]}" for s in sorted(counts)) or "(none)"))
+    metrics = dump.get("metrics") or {}
+    quantiles = metrics.get("quantiles") or {}
+    if quantiles:
+        print("histograms (seconds):")
+        for k, q in sorted(quantiles.items()):
+            p50, p99 = q.get("p50"), q.get("p99")
+            print(f"  {k:<28s} n={q.get('count'):g} "
+                  f"p50={p50 if p50 is None else format(p50, '.4g')} "
+                  f"p99={p99 if p99 is None else format(p99, '.4g')}")
+    rates = metrics.get("rates") or {}
+    if rates:
+        print("rates (trailing window):")
+        for k, v in sorted(rates.items()):
+            print(f"  {k:<40s} {v:g}/s")
+    errors = dump.get("recent_errors") or []
+    if errors:
+        print("recent errors:")
+        for e in errors[-10:]:
+            print(f"  {e}")
+    tail = (dump.get("tasks") or [])[:15]
+    if tail:
+        print("task-ring tail (newest first):")
+        for t in tail:
+            mark = f" straggler={t['straggler']}" \
+                if t.get("straggler") else ""
+            print(f"  {t['task_id'][:12]:<14s} "
+                  f"{(t.get('name') or '-')[:24]:<26s} "
+                  f"{t['state']:<10s}"
+                  f"{(' ' + (t.get('error') or ''))[:40]}{mark}")
+    print(f"spans: {len(dump.get('spans') or [])} recent "
+          f"profiling events in bundle")
 
 
 def cmd_memory(args):
@@ -563,7 +637,12 @@ def main(argv=None):
         if name == "stat":
             p.add_argument("--metrics", action="store_true",
                            help="print cluster-aggregated counters/"
-                                "gauges instead of resource state")
+                                "gauges/histogram quantiles instead of "
+                                "resource state")
+            p.add_argument("--rates", action="store_true",
+                           help="print trailing-window per-second "
+                                "counter rates from the head's rate "
+                                "ring (tasks/s, wire bytes/s, ...)")
             p.add_argument("--tasks", action="store_true",
                            help="print the task-lifecycle state table "
                                 "(per-state counts, func x state "
@@ -572,6 +651,13 @@ def main(argv=None):
                            help="dump the tunable-config registry "
                                 "(effective values; * = env override)")
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser(
+        "dump", help="pretty-print a flight-recorder postmortem JSON "
+                     "(ray_tpu.debug_dump() / the driver-fatal "
+                     "excepthook write it)")
+    p.add_argument("path", help="flight-recorder JSON file")
+    p.set_defaults(fn=cmd_dump)
 
     args = parser.parse_args(argv)
     args.fn(args)

@@ -256,18 +256,24 @@ class _Router:
         that matters). Handler EXCEPTIONS propagate without retry."""
         from ray_tpu.exceptions import (ActorDiedError,
                                         ActorUnavailableError)
+        from ray_tpu._private import metrics
         last_err = None
-        for _ in range(_max_attempts):
-            with self._lock:
-                backend = self._pick_backend_locked(endpoint)
-            rec = self._acquire_replica(backend)
-            try:
-                return ray_tpu.get(rec["handle"].handle.remote(request))
-            except (ActorDiedError, ActorUnavailableError) as e:
-                last_err = e
-                self._replace_dead_replica(backend, rec)
-            finally:
-                self._release_replica(rec)
+        # Route latency histogram spans acquire->reply including death
+        # retries — what the client actually waited, the series the
+        # ROADMAP's serve p50/p99 SLO reads.
+        with metrics.timer("serve_route_s"):
+            for _ in range(_max_attempts):
+                with self._lock:
+                    backend = self._pick_backend_locked(endpoint)
+                rec = self._acquire_replica(backend)
+                try:
+                    return ray_tpu.get(
+                        rec["handle"].handle.remote(request))
+                except (ActorDiedError, ActorUnavailableError) as e:
+                    last_err = e
+                    self._replace_dead_replica(backend, rec)
+                finally:
+                    self._release_replica(rec)
         raise last_err
 
     # -- HTTP frontend ---------------------------------------------------

@@ -271,6 +271,11 @@ class TestPipelineSmoke:
         the pipeline gauges reach the metrics plane."""
         from ray_tpu._private import metrics as metrics_mod
         from ray_tpu.rllib.agents.registry import get_trainer_class
+        # Earlier trainers in this process leave their aK gauges behind
+        # (the registry is process-global); start from a clean slate so
+        # the wait loop below observes THIS trainer's publish, not a
+        # stale k=1 lag of 0.
+        metrics_mod.reset()
         t0 = time.perf_counter()
         t = get_trainer_class("IMPALA")(config={
             "env": "SpriteAtari-v0",
