@@ -287,11 +287,24 @@ def snapshot_cluster_metrics():
                     "p95": round(q["p95"], 6),
                     "p99": round(q["p99"], 6),
                     "max": round(q["max"], 6)}
-        return {"counters": {k: round(v, 3)
-                             for k, v in sorted(agg["counters"].items())},
-                "gauges": {k: round(v, 6)
-                           for k, v in sorted(agg["gauges"].items())},
-                "latency_tails": tails}
+        out = {"counters": {k: round(v, 3)
+                            for k, v in sorted(agg["counters"].items())},
+               "gauges": {k: round(v, 6)
+                          for k, v in sorted(agg["gauges"].items())},
+               "latency_tails": tails}
+        # Device-memory watermark (profiling plane): the aggregated
+        # hbm_* gauges carry the cluster view; this block re-reads the
+        # local devices at snapshot time so BENCH json records the
+        # learner's peak HBM even if the last metrics push is stale.
+        from ray_tpu._private import profiling as profiling_mod
+        hbm = profiling_mod.device_memory_stats()
+        if hbm:
+            out["hbm_watermark"] = {
+                d["device"]: {"used": d.get("used"),
+                              "peak": d.get("peak"),
+                              "limit": d.get("limit")}
+                for d in hbm}
+        return out
     except Exception:
         return None
 

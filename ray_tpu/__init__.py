@@ -235,13 +235,42 @@ def remote(*args, **kwargs):
     return make
 
 
-def profile(event_name: str, extra_data: _Optional[dict] = None):
-    """User-level profiling span recorded into the cluster timeline
-    (parity: `ray.profile`, `python/ray/profiling.py:17`):
+def profile(event_name=None, extra_data: _Optional[dict] = None, *,
+            duration_s: _Optional[float] = None, target: str = "all",
+            hz: _Optional[float] = None):
+    """Two instruments behind one name.
+
+    With a string, a user-level profiling span recorded into the
+    cluster timeline (parity: `ray.profile`,
+    `python/ray/profiling.py:17`):
 
         with ray_tpu.profile("preprocess"):
             ...
+
+    With a number (or `duration_s=`), a coordinated cluster-wide
+    capture: the head fans a bounded window to every selected process
+    (head, drivers, node agents, workers); each runs a stack-sampling
+    profiler at RAY_TPU_PROFILE_HZ (device-owning processes also run a
+    `jax.profiler` trace), and the merged bundle comes back with
+    flamegraph-ready folded stacks per process plus Chrome-trace
+    events aligned with the span timeline:
+
+        bundle = ray_tpu.profile(2.0)                  # whole cluster
+        bundle = ray_tpu.profile(2.0, target="learner")  # device procs
+
+    `target`: "all" | "head" | "workers" | "drivers" | "nodes" |
+    "learner" | an explicit process addr. Same plane as
+    `python -m ray_tpu.scripts profile --duration 2`.
     """
+    if duration_s is None and isinstance(event_name, (int, float)) \
+            and not isinstance(event_name, bool):
+        duration_s, event_name = float(event_name), None
+    if duration_s is not None:
+        if event_name is not None:
+            raise TypeError("ray_tpu.profile: pass either a span name "
+                            "or a capture duration, not both")
+        return _ws.get_runtime().profile_capture(
+            duration_s, target=target, hz=hz)
     rt = _ws.get_runtime()
     return rt.profiler.span("user", event_name, extra_data)
 
@@ -293,8 +322,32 @@ def xla_profile(logdir: str):
 
     View with `tensorboard --logdir /tmp/prof` (profile plugin) or
     Perfetto on the generated .trace files.
+
+    Raises RuntimeError when THIS process has no XLA device to trace —
+    a driver steering remote learners holds no device; capture those
+    processes with `ray_tpu.profile(duration_s, target="learner")`
+    (or `scripts profile --target learner`), which runs the same
+    jax.profiler window inside each device-owning process.
     """
-    import jax
+    try:
+        import jax
+    except ImportError as e:
+        raise RuntimeError(
+            "ray_tpu.xla_profile requires jax in the calling process; "
+            "to capture remote device-owning processes use "
+            "ray_tpu.profile(duration_s, target='learner')") from e
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        devices = []
+    if not devices:
+        raise RuntimeError(
+            "ray_tpu.xla_profile: no XLA device is attached to this "
+            "process. xla_profile() only traces the CALLING process; "
+            "to capture the learner/worker processes that do own "
+            "devices, use ray_tpu.profile(duration_s, "
+            "target='learner') or `python -m ray_tpu.scripts profile "
+            "--target learner`.")
     return jax.profiler.trace(logdir)
 
 

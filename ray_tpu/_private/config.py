@@ -165,6 +165,20 @@ _def("RAY_TPU_FLIGHT_RECORDER_PATH", str, None,
      "Flight-recorder output path (default: "
      "<session_dir>/logs/flight_recorder.json); pretty-print with "
      "`ray_tpu.scripts dump <path>`")
+_def("RAY_TPU_PROFILE_HZ", float, 99.0,
+     "Stack-sampling frequency for coordinated captures "
+     "(ray_tpu.profile(duration_s) / `scripts profile`): "
+     "sys._current_frames() snapshots per second per process. 99 Hz "
+     "(not 100) deliberately avoids lockstep with 10ms-periodic "
+     "application timers")
+_def("RAY_TPU_PROFILE_MAX_S", float, 30.0,
+     "Upper bound on one coordinated capture window; requested "
+     "durations are clamped to it so a fat-fingered `--duration` "
+     "cannot pin sampler threads cluster-wide for minutes")
+_def("RAY_TPU_STRAGGLER_PROFILE", bool, False,
+     "Auto-trigger a short targeted stack capture of exactly the actor "
+     "the straggler detector flags; folded stacks land in "
+     "<session>/logs/ and the trainer result's stragglers.profiles")
 
 # --- actors -----------------------------------------------------------
 _def("RAY_TPU_NUM_ACTOR_CHECKPOINTS_TO_KEEP", int, 20,

@@ -77,15 +77,20 @@ def render(head) -> str:
     if not rate_rows:  # young ring: show whatever moved
         rate_rows = [(html.escape(k), f"{v:.4g}/s")
                      for k, v in sorted(rates.items())]
+    def _mem_gauge(k: str) -> bool:
+        # Store usage + profiling-plane telemetry (per-device HBM
+        # watermarks, host mem_frac) share the memory table.
+        return ("store" in k or "memory" in k or "object" in k
+                or "hbm" in k or "mem_frac" in k)
+
     store_rows = [
         (html.escape(k), "total", f"{v:g}") for k, v in sorted(
-            agg.get("gauges", {}).items())
-        if "store" in k or "memory" in k or "object" in k]
+            agg.get("gauges", {}).items()) if _mem_gauge(k)]
     for node_id in sorted(per_node):
         store_rows.extend(
             (html.escape(k), html.escape(node_id), f"{v:g}")
             for k, v in sorted(per_node[node_id]["gauges"].items())
-            if "store" in k or "memory" in k or "object" in k)
+            if _mem_gauge(k))
 
     node_rows = [(
         html.escape(n["node_id"]),

@@ -166,6 +166,12 @@ class HeadServer:
         self._unregistered_deaths = 0
         self._profile_events: List[dict] = []
         self._profile_dropped = 0
+        # Coordinated captures in flight (profiling.py StackSampler +
+        # per-process jax traces): capture_id -> {expected, results,
+        # event}; coordinator threads are tracked for shutdown join.
+        self._captures: Dict[str, dict] = {}
+        self._capture_threads: List[threading.Thread] = []
+        self._capture_counter = 0
         # Task-lifecycle ring (task_events.py; parity: GCS task events):
         # every submit/queue/lease/run/finish transition in the cluster
         # lands here, bounded, serving the state API + dashboard.
@@ -253,6 +259,11 @@ class HeadServer:
     # ------------------------------------------------------------------
     def _on_connect(self, conn: protocol.Connection, hello: dict):
         role = hello.get("role")
+        # Peer pid, used by coordinated captures to skip fanning a
+        # profile_start to a conn that is THIS process (in-process head:
+        # the driver's loopback connection) — the head's local sample
+        # already covers those threads.
+        conn.hello_pid = hello.get("pid")
         with self._lock:
             self._conns_by_addr[conn.peer_addr] = conn
             if role == "driver":
@@ -536,6 +547,19 @@ class HeadServer:
             workers = len(self._workers)
             spans = list(self._profile_events[-500:])
             errors = list(self._recent_errors)
+            host_mem = {n.node_id: n.mem_frac
+                        for n in self._nodes.values()}
+        # Profiling postmortem: last HBM/host-memory watermarks plus a
+        # one-shot folded-stack sample of this process's threads — what
+        # was everyone doing when it died.
+        from . import profiling as profiling_mod
+        profiling_sec = {
+            "hbm_gauges": {k: v for k, v in agg["gauges"].items()
+                           if k.startswith("hbm_")},
+            "host_mem_frac": host_mem,
+            "node_mem_frac_gauge": agg["gauges"].get("node_mem_frac"),
+            "head_stacks": profiling_mod.sample_once(),
+        }
         return {
             "ts": time.time(),
             "session_dir": self.session_dir,
@@ -546,6 +570,7 @@ class HeadServer:
             "nodes": nodes,
             "workers_registered": workers,
             "recent_errors": errors,
+            "profiling": profiling_sec,
         }
 
     def _h_debug_dump(self, conn, msg):
@@ -1105,6 +1130,144 @@ class HeadServer:
             dropped = self._profile_dropped
         conn.reply(msg, events=events, dropped=dropped)
 
+    # -- coordinated on-demand capture (profiling.py StackSampler) -------
+    def _h_profile_capture(self, conn, msg):
+        """Entry point of `ray_tpu.profile(duration_s)` / `scripts
+        profile`. The capture window blocks for its full duration, so
+        coordination runs on its own thread — handlers share the conn's
+        recv loop and must never sleep there."""
+        t = threading.Thread(target=self._run_profile_capture,
+                             args=(conn, msg), daemon=True,
+                             name="profile-capture")
+        with self._lock:
+            self._capture_threads = [
+                th for th in self._capture_threads if th.is_alive()]
+            self._capture_threads.append(t)
+        t.start()
+
+    def _run_profile_capture(self, conn, msg):
+        try:
+            bundle = self._coordinate_capture(msg)
+            conn.reply(msg, bundle=bundle)
+        except protocol.ConnectionClosed:
+            logger.warning("profile capture requester went away")
+        except Exception as e:
+            logger.warning("profile capture failed", exc_info=True)
+            try:
+                conn.reply_error(msg, e)
+            except protocol.ConnectionClosed:
+                pass
+
+    def _capture_peers_locked(self, target: str) -> List[tuple]:
+        """(descriptor, conn) pairs the capture fans out to. `target`:
+        "all" | "head" | "workers" | "drivers" | "nodes" | "learner"
+        (every process; non-device ones reply with a skip marker) | an
+        explicit process addr."""
+        peers: List[tuple] = []
+        if target in ("all", "workers", "learner") or ":" in target:
+            for w in self._workers.values():
+                if w.conn is not None:
+                    peers.append(({"role": "worker", "node": w.node_id,
+                                   "pid": w.pid, "addr": w.addr}, w.conn))
+        if target in ("all", "drivers", "learner") or ":" in target:
+            for d in self._drivers:
+                peers.append(({"role": "driver", "node": "node0",
+                               "pid": getattr(d, "hello_pid", None),
+                               "addr": d.peer_addr}, d))
+        if target in ("all", "nodes", "learner") or ":" in target:
+            for n in self._nodes.values():
+                if n.conn is not None:
+                    peers.append((
+                        {"role": "node_agent", "node": n.node_id,
+                         "pid": getattr(n.conn, "hello_pid", None),
+                         "addr": n.conn.peer_addr}, n.conn))
+        if ":" in target:  # explicit addr: keep only the match
+            peers = [(d, c) for d, c in peers if d["addr"] == target]
+        return peers
+
+    def _coordinate_capture(self, msg: dict) -> dict:
+        from . import profiling as profiling_mod
+        duration = max(0.05, min(float(msg.get("duration_s") or 2.0),
+                                 config.get("RAY_TPU_PROFILE_MAX_S")))
+        hz = msg.get("hz") or config.get("RAY_TPU_PROFILE_HZ")
+        target = msg.get("target") or "all"
+        my_pid = os.getpid()
+        with self._lock:
+            self._capture_counter += 1
+            cid = "cap%d-%d" % (self._capture_counter, my_pid)
+            peers = [(d, c) for d, c in self._capture_peers_locked(target)
+                     if d.get("pid") != my_pid]
+            entry = {"results": {}, "event": threading.Event(),
+                     "expected": {d["addr"] for d, _ in peers}}
+            self._captures[cid] = entry
+        xla_root = os.path.join(self.session_dir, "logs",
+                                "xla_profile_%s" % cid)
+        t0 = time.time()
+        for d, c in peers:
+            try:
+                c.send({"kind": "profile_start", "capture_id": cid,
+                        "duration_s": duration, "hz": hz,
+                        "target": target,
+                        "xla_dir": os.path.join(
+                            xla_root, "%s-%s" % (d["role"], d["pid"]))})
+            except protocol.ConnectionClosed:
+                with self._lock:
+                    entry["expected"].discard(d["addr"])
+        # The head samples its own process inline (also covering the
+        # in-process driver's threads, skipped above by pid).
+        local = None
+        if target in ("all", "head") or (
+                target == "learner" and profiling_mod.owns_device()):
+            local = profiling_mod.run_capture(
+                duration, hz=hz,
+                xla_dir=os.path.join(xla_root, "head-%d" % my_pid))
+            local.update({"role": "head", "node": "node0",
+                          "addr": "head"})
+        # Wait out the window plus shipping grace for remote results.
+        deadline = t0 + duration + 10.0
+        while True:
+            with self._lock:
+                missing = entry["expected"] - set(entry["results"])
+            if not missing:
+                break
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                logger.warning("profile capture %s: no result from %s",
+                               cid, sorted(missing))
+                break
+            entry["event"].wait(min(remaining, 0.5))
+            entry["event"].clear()
+        t1 = time.time()
+        with self._lock:
+            results = dict(self._captures.pop(cid)["results"])
+            spans = [e for e in self._profile_events
+                     if e.get("end", 0.0) >= t0
+                     and e.get("start", float("inf")) <= t1]
+        processes = ([local] if local else []) + [
+            results[a] for a in sorted(results)]
+        trace = profiling_mod.chrome_trace(spans)
+        for p in processes:
+            trace.extend(profiling_mod.samples_to_chrome(p))
+            # Raw samples are re-emitted above; the bundle keeps the
+            # (much smaller) folded stacks + counters per process.
+            p.pop("samples", None)
+        return {"capture_id": cid, "duration_s": duration, "hz": hz,
+                "target": target, "t0": t0, "t1": t1,
+                "processes": processes, "trace_events": trace,
+                "spans_in_window": len(spans),
+                "missing": sorted(missing)}
+
+    def _h_profile_result(self, conn, msg):
+        with self._lock:
+            entry = self._captures.get(msg.get("capture_id"))
+            if entry is None:
+                logger.warning("profile result for unknown capture %s",
+                               msg.get("capture_id"))
+                return
+            addr = msg.get("addr") or conn.peer_addr
+            entry["results"][addr] = msg.get("result") or {}
+            entry["event"].set()
+
     # -- task lifecycle state API (task_events.py) -----------------------
     def _h_task_events(self, conn, msg):
         for ev in msg.get("events", ()):
@@ -1573,3 +1736,13 @@ class HeadServer:
             self._log_tailer.join(timeout=1.0)
         if self._monitor_thread is not threading.current_thread():
             self._monitor_thread.join(timeout=2.0)
+        # In-flight capture coordinators: unblock their waits and join.
+        with self._lock:
+            captures = list(self._captures.values())
+            capture_threads = list(self._capture_threads)
+        for entry in captures:
+            entry["expected"].clear()
+            entry["event"].set()
+        for t in capture_threads:
+            if t is not threading.current_thread():
+                t.join(timeout=2.0)

@@ -70,6 +70,15 @@ and `sebulba_policy_lag_steps.aK` (mean behavior-policy selection lag
 per transition under `sebulba_onchip_steps` windows). Updated at
 sample-fragment boundaries; declared with mean roll-up so the cluster
 series stays a percentage; per-actor values remain under `per_node`.
+
+Profiling-plane series (profiling.py + the coordinated-capture
+tentpole): max-rollup gauges `hbm_used_bytes.dK` / `hbm_peak_bytes.dK`
+/ `hbm_limit_bytes.dK` (per-device `device.memory_stats()` watermarks,
+published continuously by the node agents and every runtime process
+that imported jax; absent on CPU-only hosts) and `node_mem_frac`
+(host-memory pressure, the heartbeat field promoted to a proper gauge
+with per-node series); counter `straggler_profiles_total`
+(RAY_TPU_STRAGGLER_PROFILE auto-captures fired).
 """
 
 from __future__ import annotations

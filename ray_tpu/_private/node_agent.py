@@ -51,6 +51,8 @@ class NodeAgent:
         self._procs: Dict[str, subprocess.Popen] = {}  # token -> proc
         self._lock = threading.Lock()
         self._shutdown = threading.Event()
+        # Coordinated-capture threads (head-fanned "profile_start").
+        self._capture_threads: list = []
         # Chaos plane (chaos.py): heartbeat suppression etc.
         from . import chaos
         ctl = chaos.install_from_env()
@@ -102,10 +104,49 @@ class NodeAgent:
                     proc.kill()
                 except OSError:
                     pass
+        elif kind == "profile_start":
+            self._on_profile_start(msg)
         elif kind == "shutdown":
             self.shutdown()
         else:
             logger.warning("agent: unknown message %s", kind)
+
+    def _on_profile_start(self, msg: dict):
+        """One bounded capture window of this agent process (head
+        coordinates; see head._coordinate_capture). Runs on its own
+        thread — the recv loop must stay free for spawn/kill traffic."""
+        def _run():
+            from . import profiling as profiling_mod
+            try:
+                if msg.get("target") == "learner" \
+                        and not profiling_mod.owns_device():
+                    res = {"skipped": "no accelerator device",
+                           "folded": {}, "samples": [], "dropped": 0,
+                           "ticks": 0, "threads": []}
+                else:
+                    res = profiling_mod.run_capture(
+                        msg.get("duration_s", 1.0), hz=msg.get("hz"),
+                        xla_dir=msg.get("xla_dir"),
+                        abort_event=self._shutdown)
+                res.update({"role": "node_agent", "node": self.node_id,
+                            "pid": os.getpid(),
+                            "addr": f"agent:{self.node_id}"})
+                self.head.send({"kind": "profile_result",
+                                "capture_id": msg["capture_id"],
+                                "addr": f"agent:{self.node_id}",
+                                "result": res})
+            except protocol.ConnectionClosed:
+                logger.warning("profile result lost: head went away")
+            except Exception:
+                logger.warning("agent profile capture failed",
+                               exc_info=True)
+        t = threading.Thread(target=_run, daemon=True,
+                             name="profile-capture")
+        with self._lock:
+            self._capture_threads = [
+                th for th in self._capture_threads if th.is_alive()]
+            self._capture_threads.append(t)
+        t.start()
 
     def _spawn_worker(self, token: str, extra_env: Dict[str, str]):
         env = dict(os.environ)
@@ -136,10 +177,14 @@ class NodeAgent:
         # beating (reference: raylet_heartbeat_timeout_milliseconds,
         # `ray_config_def.h:24`).
         from . import config
+        from . import metrics as metrics_mod
+        from . import profiling as profiling_mod
         from .memory_monitor import MemoryMonitor
         hb_interval = config.get("RAY_TPU_HEARTBEAT_INTERVAL_S")
+        metrics_interval = config.get("RAY_TPU_METRICS_INTERVAL_S")
         mem_monitor = MemoryMonitor()
         last_hb = 0.0
+        last_metrics = 0.0
         while not self._shutdown.is_set():
             time.sleep(0.05)
             now = time.monotonic()
@@ -161,6 +206,29 @@ class NodeAgent:
                         "node_id": self.node_id,
                         "mem_frac": 0.0 if mem_monitor.disabled
                         else round(mem_monitor.mem_frac(), 4)})
+                except protocol.ConnectionClosed:
+                    return
+            if metrics_interval > 0 \
+                    and now - last_metrics >= metrics_interval:
+                # The agent is the node's telemetry arm even when no
+                # worker runs: host-memory pressure and per-device HBM
+                # watermarks go into the metrics plane as max-rollup
+                # gauges with per-node series (Prometheus /
+                # `stat --metrics` / dashboard).
+                last_metrics = now
+                if not mem_monitor.disabled:
+                    metrics_mod.set_gauge(
+                        "node_mem_frac", mem_monitor.mem_frac(),
+                        rollup="max")
+                profiling_mod.publish_device_gauges()
+                snap = metrics_mod.snapshot()
+                try:
+                    self.head.send({"kind": "metrics_push",
+                                    "node": self.node_id,
+                                    "counters": snap["counters"],
+                                    "gauges": snap["gauges"],
+                                    "hists": snap["hists"],
+                                    "rollups": snap["rollups"]})
                 except protocol.ConnectionClosed:
                     return
             dead = []
@@ -212,6 +280,13 @@ class NodeAgent:
                 self._log_tailer.join(timeout=1.0)
         if self._monitor_thread is not threading.current_thread():
             self._monitor_thread.join(timeout=2.0)
+        with self._lock:
+            captures = list(self._capture_threads)
+        for t in captures:
+            if t is not threading.current_thread():
+                # run_capture waits on self._shutdown, so these unblock
+                # promptly once the event is set.
+                t.join(timeout=2.0)
 
     def wait(self):
         self._shutdown.wait()

@@ -13,15 +13,35 @@ Chrome flow events (`ph:"s"/"t"/"f"`, keyed by the task id), so Perfetto
 draws arrows from a driver's submit span to the worker's exec span and
 the object-transfer spans of that task's results — instead of
 disconnected per-process lanes.
+
+On-demand captures (the active profiling plane) also live here:
+
+  - `StackSampler` — a stdlib sampling profiler: a service thread reads
+    `sys._current_frames()` at RAY_TPU_PROFILE_HZ and accumulates
+    per-thread folded stacks (flamegraph-ready) plus a bounded raw
+    sample list with drop accounting. Started/stopped per capture
+    window by `run_capture()`, which adds a `jax.profiler` trace for
+    the same window in device-owning processes.
+  - `sample_once()` — one-shot folded stacks of the current process's
+    threads, used by the flight recorder's `profiling` postmortem
+    section.
+  - `samples_to_chrome()` — re-emits raw samples as Chrome-trace "X"
+    events on the same wall clock (`ts = time.time()*1e6`) and pid
+    convention (`role:pid`) as the span events above, so sampled
+    frames, host spans, and device traces line up in one timeline.
+  - `device_memory_stats()` / `publish_device_gauges()` — per-device
+    HBM used/peak/limit via `device.memory_stats()`, degrading to
+    nothing on backends (CPU) that return None.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional, Set
 
 FLUSH_INTERVAL = 1.0
 MAX_BUFFER = 5000
@@ -190,3 +210,295 @@ def dump_chrome_trace(events: List[dict], filename: str,
     with open(filename, "w") as f:
         json.dump(chrome_trace(events, dropped=dropped), f)
     return filename
+
+
+# ---------------------------------------------------------------------
+# Stack sampling (coordinated on-demand capture)
+# ---------------------------------------------------------------------
+
+MAX_STACK_DEPTH = 64
+MAX_RAW_SAMPLES = 20_000  # per capture window, per process
+
+
+def _fold_frame(frame, thread_name: str) -> str:
+    """Walk a frame's f_back chain into a root-first folded stack:
+    `thread;file:func;file:func;...` — the flamegraph.pl input line
+    format (minus the trailing count)."""
+    stack = []
+    f = frame
+    depth = 0
+    while f is not None and depth < MAX_STACK_DEPTH:
+        code = f.f_code
+        stack.append("%s:%s" % (os.path.basename(code.co_filename),
+                                code.co_name))
+        f = f.f_back
+        depth += 1
+    stack.reverse()
+    return thread_name + ";" + ";".join(stack)
+
+
+class StackSampler:
+    """Stdlib sampling profiler for one bounded capture window.
+
+    A service thread snapshots `sys._current_frames()` at `hz`
+    (default RAY_TPU_PROFILE_HZ) and accumulates (a) folded-stack
+    counts per thread — flamegraph-ready — and (b) a bounded raw
+    sample list (wall-clock timestamped) for Chrome-trace re-emission.
+    Overrun ticks and samples past the cap are counted in `dropped`
+    rather than silently lost. Lifecycle matches every other service
+    thread: `start()`, then `stop()` sets the event and JOINS.
+    `thread_names` restricts sampling to those threads (targeted
+    straggler captures)."""
+
+    def __init__(self, hz: Optional[float] = None,
+                 thread_names: Optional[Set[str]] = None,
+                 max_samples: int = MAX_RAW_SAMPLES):
+        from . import config
+        self.hz = float(hz if hz else config.get("RAY_TPU_PROFILE_HZ"))
+        self.hz = max(1.0, min(self.hz, 1000.0))
+        self.period = 1.0 / self.hz
+        self.thread_names = set(thread_names) if thread_names else None
+        self.max_samples = int(max_samples)
+        self.folded: Dict[str, int] = {}
+        self.samples: List[tuple] = []  # (ts, tid, thread_name, folded)
+        self.ticks = 0
+        self.dropped = 0
+        self.started_at: Optional[float] = None
+        self.stopped_at: Optional[float] = None
+        self._seen_threads: Set[str] = set()
+        self._stop_event = threading.Event()
+        self._thread = threading.Thread(
+            target=self._sample_loop, daemon=True, name="stack-sampler")
+
+    def start(self) -> "StackSampler":
+        self.started_at = time.time()
+        self._thread.start()
+        return self
+
+    def stop(self) -> "StackSampler":
+        self._stop_event.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5.0)
+        if self.stopped_at is None:
+            self.stopped_at = time.time()
+        return self
+
+    def _sample_loop(self):
+        next_tick = time.monotonic()
+        while not self._stop_event.is_set():
+            self._sample_tick()
+            next_tick += self.period
+            delay = next_tick - time.monotonic()
+            if delay <= 0:
+                # Sampling overran the period: account the missed ticks
+                # and resync instead of spinning to catch up.
+                self.dropped += int(-delay / self.period) + 1
+                next_tick = time.monotonic() + self.period
+                delay = self.period
+            self._stop_event.wait(delay)
+
+    def _sample_tick(self):
+        now = time.time()
+        names = {t.ident: t.name for t in threading.enumerate()}
+        me = threading.get_ident()
+        for tid, frame in sys._current_frames().items():
+            if tid == me:
+                continue  # never profile the profiler
+            name = names.get(tid) or ("tid-%d" % tid)
+            if self.thread_names is not None and name not in self.thread_names:
+                continue
+            folded = _fold_frame(frame, name)
+            self.folded[folded] = self.folded.get(folded, 0) + 1
+            self._seen_threads.add(name)
+            if len(self.samples) < self.max_samples:
+                self.samples.append((now, tid % 100000, name, folded))
+            else:
+                self.dropped += 1
+        self.ticks += 1
+
+    def result(self) -> dict:
+        return {
+            "folded": dict(self.folded),
+            "samples": list(self.samples),
+            "ticks": self.ticks,
+            "dropped": self.dropped,
+            "threads": sorted(self._seen_threads),
+            "hz": self.hz,
+            "start": self.started_at,
+            "end": self.stopped_at,
+        }
+
+
+def sample_once() -> Dict[str, str]:
+    """One-shot folded stacks of every thread in THIS process (keyed by
+    thread name) — the flight recorder's 'what was everyone doing when
+    it died' snapshot."""
+    names = {t.ident: t.name for t in threading.enumerate()}
+    me = threading.get_ident()
+    out: Dict[str, str] = {}
+    for tid, frame in sys._current_frames().items():
+        if tid == me:
+            continue
+        name = names.get(tid) or ("tid-%d" % tid)
+        out[name] = _fold_frame(frame, name)
+    return out
+
+
+def owns_device() -> bool:
+    """True when this process has a non-CPU XLA device attached (so a
+    `jax.profiler` trace would capture real device activity). Never
+    imports jax itself: a process that did not pay the import does not
+    own a device."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return False
+    try:
+        return any(d.platform != "cpu" for d in jax.local_devices())
+    except Exception:
+        return False
+
+
+def run_capture(duration_s: float, hz: Optional[float] = None,
+                thread_names: Optional[Set[str]] = None,
+                xla_dir: Optional[str] = None,
+                abort_event: Optional[threading.Event] = None) -> dict:
+    """Run one bounded capture window in THIS process: stack sampling
+    for `duration_s` plus, when `xla_dir` is given and the process owns
+    a device, a `jax.profiler` trace over the same window. Returns the
+    sampler result augmented with pid/HBM/XLA fields — the per-process
+    payload a coordinated capture ships back to the head."""
+    from . import config
+    duration_s = max(0.05, min(float(duration_s),
+                               config.get("RAY_TPU_PROFILE_MAX_S")))
+    sampler = StackSampler(hz=hz, thread_names=thread_names).start()
+    xla_trace_dir = None
+    xla_error = None
+    tracing = False
+    if xla_dir and owns_device():
+        try:
+            import jax
+            os.makedirs(xla_dir, exist_ok=True)
+            jax.profiler.start_trace(xla_dir)
+            tracing = True
+            xla_trace_dir = xla_dir
+        except Exception as e:
+            xla_error = "%s: %s" % (type(e).__name__, e)
+    if abort_event is not None:
+        abort_event.wait(duration_s)
+    else:
+        time.sleep(duration_s)
+    if tracing:
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception as e:
+            xla_error = "%s: %s" % (type(e).__name__, e)
+            xla_trace_dir = None
+    sampler.stop()
+    out = sampler.result()
+    out["pid"] = os.getpid()
+    out["duration_s"] = duration_s
+    out["xla_trace_dir"] = xla_trace_dir
+    if xla_error:
+        out["xla_error"] = xla_error
+    hbm = device_memory_stats()
+    if hbm:
+        out["hbm"] = hbm
+    return out
+
+
+def samples_to_chrome(proc: dict) -> List[dict]:
+    """Re-emit one process's raw stack samples as Chrome-trace "X"
+    events on the SAME clock (`ts = wall_time*1e6`) and pid convention
+    (`role:pid`) as span events from `chrome_trace()`, so sampled
+    frames interleave with task spans in one timeline. Each sample
+    renders as a slice one sample-period wide named after its leaf
+    frame, with the full folded stack in args."""
+    hz = float(proc.get("hz") or 99.0)
+    dur_us = 1e6 / hz
+    pid = "%s:%s" % (proc.get("role", "?"), proc.get("pid", 0))
+    out = []
+    for (ts, tid, _name, folded) in proc.get("samples") or ():
+        out.append({
+            "cat": "stack_sample",
+            "name": folded.rsplit(";", 1)[-1],
+            "ph": "X",
+            "ts": ts * 1e6,
+            "dur": dur_us,
+            "pid": pid,
+            "tid": tid,
+            "args": {"stack": folded},
+        })
+    return out
+
+
+def top_frames(folded: Dict[str, int], n: int = 10) -> List[tuple]:
+    """Hottest leaf frames of a folded-stack dict, as (frame, count,
+    share) tuples — the `scripts profile --summarize` view."""
+    counts: Dict[str, int] = {}
+    total = 0
+    for stack, c in folded.items():
+        leaf = stack.rsplit(";", 1)[-1]
+        counts[leaf] = counts.get(leaf, 0) + c
+        total += c
+    ranked = sorted(counts.items(), key=lambda kv: (-kv[1], kv[0]))[:n]
+    return [(frame, c, (c / total if total else 0.0))
+            for frame, c in ranked]
+
+
+# ---------------------------------------------------------------------
+# Device (HBM) telemetry
+# ---------------------------------------------------------------------
+
+def device_memory_stats() -> List[dict]:
+    """Per-device HBM stats via `device.memory_stats()`. Returns [] when
+    jax was never imported here, and skips devices whose backend
+    returns None/empty (the CPU backend) — telemetry degrades to
+    nothing rather than erroring on hosts without accelerators."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return []
+    try:
+        devices = jax.local_devices()
+    except Exception:
+        return []
+    out = []
+    for d in devices:
+        try:
+            stats = d.memory_stats()
+        except Exception:
+            stats = None
+        if not stats:
+            continue
+        out.append({
+            "device": "d%d" % d.id,
+            "platform": getattr(d, "platform", "?"),
+            "kind": getattr(d, "device_kind", ""),
+            "used": stats.get("bytes_in_use"),
+            "peak": stats.get("peak_bytes_in_use"),
+            "limit": stats.get("bytes_limit"),
+        })
+    return out
+
+
+def publish_device_gauges() -> int:
+    """Publish per-device HBM used/peak/limit into this process's
+    metric registry as max-rollup gauges (`hbm_used_bytes.d0`, ...).
+    Called from the periodic metric push loops (runtime + node agent);
+    returns the number of gauge series set (0 on CPU-only hosts)."""
+    stats = device_memory_stats()
+    if not stats:
+        return 0
+    from . import metrics
+    n = 0
+    for s in stats:
+        tag = s["device"]
+        for key, gauge in (("used", "hbm_used_bytes"),
+                           ("peak", "hbm_peak_bytes"),
+                           ("limit", "hbm_limit_bytes")):
+            v = s.get(key)
+            if v is not None:
+                metrics.set_gauge("%s.%s" % (gauge, tag), float(v),
+                                  rollup="max")
+                n += 1
+    return n

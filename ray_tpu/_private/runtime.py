@@ -1043,6 +1043,11 @@ class Runtime:
         self._pre_actor_tasks: List[TaskSpec] = []
         self._pre_actor_lock = make_lock("Runtime._pre_actor_lock")
         self._shutdown_event = threading.Event()
+        # Coordinated-capture threads (head-fanned "profile_start"):
+        # each runs one bounded stack/XLA window; tracked for the
+        # shutdown join like every other service thread.
+        self._capture_threads: List[threading.Thread] = []
+        self._capture_lock = make_lock("Runtime._capture_lock")
 
         # The tracker must be live BEFORE the server accepts its first
         # message: a spec can arrive the instant registration completes,
@@ -2478,6 +2483,16 @@ class Runtime:
                 metrics_mod.set_gauge(
                     "wire_send_mbps",
                     float(sum(p.ema_mbps or 0.0 for p in pools)))
+                # Profiling plane: host-memory pressure as a proper
+                # max-rollup gauge (not just the heartbeat field) and
+                # per-device HBM used/peak/limit watermarks — no-ops
+                # on hosts without /proc or accelerators.
+                if not self._memory_monitor.disabled:
+                    metrics_mod.set_gauge(
+                        "node_mem_frac", self._memory_monitor.mem_frac(),
+                        rollup="max")
+                from . import profiling as profiling_mod
+                profiling_mod.publish_device_gauges()
                 snap = metrics_mod.snapshot()
                 self.head.send({"kind": "metrics_push",
                                 "node": self.node_id,
@@ -2523,6 +2538,16 @@ class Runtime:
             pass
         dump = self.head.request({"kind": "debug_dump"},
                                  timeout=30)["dump"]
+        # The head's bundle samples ITS process; add the dumping
+        # process's own one-shot folded stacks (and device watermark)
+        # so a driver-fatal postmortem shows what the driver's threads
+        # were doing, not just the head's.
+        from . import profiling as profiling_mod
+        sec = dump.setdefault("profiling", {})
+        sec["driver_stacks"] = profiling_mod.sample_once()
+        hbm = profiling_mod.device_memory_stats()
+        if hbm:
+            sec["driver_hbm"] = hbm
         if path is None:
             path = config.get("RAY_TPU_FLIGHT_RECORDER_PATH") \
                 or os.path.join(self.session_dir, "logs",
@@ -2540,6 +2565,56 @@ class Runtime:
                                   timeout=30)
         return {"events": reply["events"],
                 "dropped": reply.get("dropped", 0)}
+
+    # -- coordinated on-demand capture (profiling.py) ------------------
+    def profile_capture(self, duration_s: float, target: str = "all",
+                        hz: Optional[float] = None) -> dict:
+        """Ask the head to run one cluster-wide capture window and
+        return the merged bundle (per-process folded stacks + Chrome
+        trace events aligned with the span timeline)."""
+        duration_s = max(0.05, min(float(duration_s),
+                                   config.get("RAY_TPU_PROFILE_MAX_S")))
+        # Ship pending spans first so they land inside the window.
+        self.profiler.flush()
+        reply = self.head.request(
+            {"kind": "profile_capture", "duration_s": duration_s,
+             "target": target, "hz": hz},
+            timeout=duration_s + 60.0)
+        return reply["bundle"]
+
+    def _on_profile_start(self, conn: protocol.Connection, msg: dict):
+        """Head-fanned capture window: sample THIS process on a
+        dedicated bounded thread (the conn's recv loop must stay free —
+        the result ships back on the same head connection)."""
+        def _run():
+            from . import profiling as profiling_mod
+            try:
+                if msg.get("target") == "learner" \
+                        and not profiling_mod.owns_device():
+                    res = {"skipped": "no accelerator device",
+                           "folded": {}, "samples": [], "dropped": 0,
+                           "ticks": 0, "threads": []}
+                else:
+                    res = profiling_mod.run_capture(
+                        msg.get("duration_s", 1.0), hz=msg.get("hz"),
+                        xla_dir=msg.get("xla_dir"),
+                        abort_event=self._shutdown_event)
+                res.update({"role": self.role, "node": self.node_id,
+                            "pid": os.getpid(), "addr": self.addr})
+                self.head.send({"kind": "profile_result",
+                                "capture_id": msg["capture_id"],
+                                "addr": self.addr, "result": res})
+            except protocol.ConnectionClosed:
+                logger.warning("profile result lost: head went away")
+            except Exception:
+                logger.warning("profile capture failed", exc_info=True)
+        t = threading.Thread(target=_run, daemon=True,
+                             name="profile-capture")
+        with self._capture_lock:
+            self._capture_threads = [
+                th for th in self._capture_threads if th.is_alive()]
+            self._capture_threads.append(t)
+        t.start()
 
     # ==================================================================
     # connections
@@ -2662,6 +2737,8 @@ class Runtime:
             self._on_lease_worker_lost(msg["worker_addr"])
         elif kind == "publish":
             self._on_publish(msg)
+        elif kind == "profile_start":
+            self._on_profile_start(conn, msg)
         elif kind == "shutdown":
             self._shutdown_event.set()
             os._exit(0)
@@ -3649,6 +3726,11 @@ class Runtime:
             self._lease_sweeper_thread.join(timeout=left())
         if self._task_thread is not None and self._task_thread is not me:
             self._task_thread.join(timeout=left())
+        with self._capture_lock:
+            captures = list(self._capture_threads)
+        for t in captures:
+            if t is not me:
+                t.join(timeout=left())
 
     def shutdown(self):
         self._shutdown_event.set()

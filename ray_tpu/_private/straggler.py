@@ -26,11 +26,24 @@ annotate the flagged worker's task records via task_events.ANNOTATE,
 and ride the optimizer's stats() into the trainer's iteration results
 (`result["stragglers"]`). k and the minimum fleet size are the
 RAY_TPU_STRAGGLER_K / RAY_TPU_STRAGGLER_MIN_PEERS knobs.
+
+`TriggeredCapture` turns a flag into a diagnosis: with
+RAY_TPU_STRAGGLER_PROFILE=1 the optimizer hands each flagged tag to
+`maybe_trigger()`, which runs a short stack capture (profiling.py
+StackSampler) restricted to exactly the flagged actor's thread and
+writes the folded stacks to <session>/logs/ — the flamegraph of what
+the slow actor was doing, taken while it was still slow.
 """
 
 from __future__ import annotations
 
+import logging
+import os
+import threading
+import time
 from typing import Dict, List, Optional
+
+logger = logging.getLogger(__name__)
 
 # MAD -> sigma consistency constant for a normal distribution.
 MAD_SIGMA = 1.4826
@@ -124,3 +137,90 @@ class StragglerDetector:
             "flag_counts": dict(self.flag_counts),
             "per_actor": verdicts,
         }
+
+
+class TriggeredCapture:
+    """Straggler flag -> targeted stack capture (the
+    RAY_TPU_STRAGGLER_PROFILE plane).
+
+    Each `maybe_trigger(tag, thread_name)` spawns one short bounded
+    StackSampler window restricted to `thread_name` and writes the
+    folded stacks to `<out_dir>/straggler_profile_<tag>_<n>.folded`
+    (flamegraph.pl input). Per-tag throttled: a persistently slow actor
+    yields one flamegraph per `min_interval_s`, not one per detector
+    window. `paths()` exposes completed captures for the trainer
+    report; `stop()` aborts in-flight windows and joins, like every
+    other service-thread owner."""
+
+    def __init__(self, out_dir: str, duration_s: float = 0.5,
+                 hz: Optional[float] = None,
+                 min_interval_s: float = 60.0):
+        self.out_dir = out_dir
+        self.duration_s = duration_s
+        self.hz = hz
+        self.min_interval_s = min_interval_s
+        self._lock = threading.Lock()
+        self._last_trigger: Dict[str, float] = {}
+        self._paths: Dict[str, str] = {}
+        self._threads: List[threading.Thread] = []
+        self._counter = 0
+        self._stop_event = threading.Event()
+
+    def maybe_trigger(self, tag: str, thread_name: str) -> bool:
+        """Start a capture of `thread_name` for flagged actor `tag`
+        unless one ran recently. Returns True when a capture started."""
+        now = time.monotonic()
+        with self._lock:
+            if self._stop_event.is_set():
+                return False
+            last = self._last_trigger.get(tag)
+            if last is not None and now - last < self.min_interval_s:
+                return False
+            self._last_trigger[tag] = now
+            self._counter += 1
+            n = self._counter
+            self._threads = [t for t in self._threads if t.is_alive()]
+            t = threading.Thread(
+                target=self._capture, args=(tag, thread_name, n),
+                daemon=True, name=f"straggler-profile-{tag}")
+            self._threads.append(t)
+        t.start()
+        return True
+
+    def _capture(self, tag: str, thread_name: str, n: int):
+        from . import metrics, profiling
+        try:
+            res = profiling.run_capture(
+                self.duration_s, hz=self.hz,
+                thread_names={thread_name},
+                abort_event=self._stop_event)
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(
+                self.out_dir, f"straggler_profile_{tag}_{n}.folded")
+            with open(path, "w") as f:
+                for stack, count in sorted(res["folded"].items()):
+                    f.write(f"{stack} {count}\n")
+            with self._lock:
+                self._paths[tag] = path
+            metrics.inc("straggler_profiles_total")
+            logger.warning(
+                "straggler %s: captured %d stack sample(s) of thread "
+                "%r -> %s", tag, sum(res["folded"].values()),
+                thread_name, path)
+        except Exception:
+            logger.warning("straggler capture for %s failed", tag,
+                           exc_info=True)
+
+    def paths(self) -> Dict[str, str]:
+        """tag -> folded-stack file of the latest completed capture."""
+        with self._lock:
+            return dict(self._paths)
+
+    def stop(self):
+        self._stop_event.set()
+        with self._lock:
+            threads = list(self._threads)
+        me = threading.current_thread()
+        for t in threads:
+            if t is not me:
+                t.join(timeout=2.0)

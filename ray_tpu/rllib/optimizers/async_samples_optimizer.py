@@ -16,7 +16,9 @@ reference's `_LoaderThread` (`aso_multi_gpu_learner.py:140`).
 from __future__ import annotations
 
 import logging
+import os
 import queue
+import tempfile
 import threading
 import time
 from typing import List
@@ -60,6 +62,7 @@ class LearnerThread(threading.Thread):
         self.queue_timer = _Timer()
         self.grad_timer = _Timer()
         self.daemon = True
+        self._hbm_last = 0.0
 
     def run(self):
         while not self.stopped:
@@ -98,6 +101,15 @@ class LearnerThread(threading.Thread):
                     stats = policy.learn_on_batch(batch)
             self.stats = stats
         metrics_mod.observe("learner_grad_s", time.perf_counter() - t1)
+        now = time.monotonic()
+        if now - self._hbm_last >= 2.0:
+            # The learner owns the mesh, so its process is where HBM
+            # peaks move: refresh the per-device used/peak/limit gauges
+            # right after a grad step (the runtime's 2s metrics push
+            # ships them; no-op without accelerators).
+            self._hbm_last = now
+            from ..._private import profiling as profiling_mod
+            profiling_mod.publish_device_gauges()
         self.weights_updated = True
         metrics_mod.inc("rllib_steps_trained", batch.count)
         self.outqueue.put(batch.count)
@@ -267,6 +279,19 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         from ..._private.straggler import StragglerDetector
         self._straggler = StragglerDetector()
         self._straggler_report = {}
+        # Flag -> diagnosis (RAY_TPU_STRAGGLER_PROFILE): a flagged
+        # inline actor gets a short stack capture of exactly its
+        # thread; folded stacks land in <session>/logs and the paths
+        # ride the straggler report.
+        self._strag_capture = None
+        from ..._private import config as _config
+        if _config.get("RAY_TPU_STRAGGLER_PROFILE"):
+            from ..._private import worker_state as _ws
+            from ..._private.straggler import TriggeredCapture
+            rt = _ws.get_runtime_or_none()
+            out_dir = os.path.join(rt.session_dir, "logs") \
+                if rt is not None else tempfile.gettempdir()
+            self._strag_capture = TriggeredCapture(out_dir)
         self._strag_prev = {}
         self._strag_t0 = time.monotonic()
         self._worker_tags = {}
@@ -566,7 +591,19 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                     if tid:
                         rt.task_events.record(tid, te.ANNOTATE,
                                               straggler=tag)
+        if flagged and self._strag_capture is not None:
+            for tag in flagged:
+                # Inline-actor tags map to threads of THIS process, so
+                # a targeted capture reaches them; remote-worker tags
+                # have no local thread to sample.
+                if tag.startswith("a") and tag[1:].isdigit():
+                    self._strag_capture.maybe_trigger(
+                        tag, thread_name=f"inline-actor-{tag[1:]}")
         self._straggler_report = self._straggler.report(verdicts)
+        if self._strag_capture is not None:
+            profiles = self._strag_capture.paths()
+            if profiles:
+                self._straggler_report["profiles"] = profiles
         return self._straggler_report
 
     def stats(self) -> dict:
@@ -597,6 +634,10 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         for a in self._inline_actors:
             a.stop()
         self.learner.stop()
+        if self._strag_capture is not None:
+            # Abort in-flight straggler captures BEFORE joining the
+            # actors they sample.
+            self._strag_capture.stop()
         for a in self._inline_actors:
             a.join(timeout=5.0)
         self.learner.join(timeout=5.0)

@@ -449,6 +449,91 @@ def cmd_dump(args):
                   f"{(' ' + (t.get('error') or ''))[:40]}{mark}")
     print(f"spans: {len(dump.get('spans') or [])} recent "
           f"profiling events in bundle")
+    prof = dump.get("profiling") or {}
+    if prof:
+        print("profiling:")
+        host = prof.get("host_mem_frac") or {}
+        if host:
+            print("  host mem_frac: " + " ".join(
+                f"{n}={v:.0%}" for n, v in sorted(host.items())
+                if isinstance(v, (int, float))))
+        hbm = prof.get("hbm_gauges") or {}
+        for k, v in sorted(hbm.items()):
+            print(f"  {k:<32s} {v:g}")
+        for key in ("head_stacks", "driver_stacks"):
+            stacks = prof.get(key) or {}
+            if stacks:
+                print(f"  {key} ({len(stacks)} thread(s)):")
+                for name in sorted(stacks):
+                    leaf = stacks[name].rsplit(";", 1)[-1]
+                    print(f"    {name:<24s} {leaf}")
+
+
+def _print_profile_summary(bundle: dict, top: int = 8):
+    """Top-N hottest frames per process — the bundle usable without
+    flamegraph tooling."""
+    from ray_tpu._private.profiling import top_frames
+    procs = bundle.get("processes") or []
+    print(f"capture {bundle.get('capture_id')}: "
+          f"{bundle.get('duration_s')}s @ {bundle.get('hz')}Hz, "
+          f"{len(procs)} process(es), "
+          f"{len(bundle.get('trace_events') or [])} trace event(s)"
+          + (f"; MISSING results from {bundle['missing']}"
+             if bundle.get("missing") else ""))
+    for p in procs:
+        label = f"{p.get('role', '?')}:{p.get('pid', '?')}" \
+                f"@{p.get('node', '?')}"
+        if p.get("skipped"):
+            print(f"-- {label}: skipped ({p['skipped']})")
+            continue
+        total = sum((p.get("folded") or {}).values())
+        drops = f", {p['dropped']} dropped" if p.get("dropped") else ""
+        xla = f", xla trace: {p['xla_trace_dir']}" \
+            if p.get("xla_trace_dir") else ""
+        print(f"-- {label}: {total} samples over "
+              f"{len(p.get('threads') or [])} thread(s){drops}{xla}")
+        for frame, count, share in top_frames(p.get("folded") or {},
+                                              n=top):
+            print(f"   {share:6.1%} {count:>6d}  {frame}")
+        for d in p.get("hbm") or []:
+            print(f"   hbm {d['device']} ({d.get('kind') or d.get('platform')}): "
+                  f"used={d.get('used')} peak={d.get('peak')} "
+                  f"limit={d.get('limit')}")
+
+
+def cmd_profile(args):
+    """Coordinated cluster capture (the `ray_tpu.profile(duration_s)`
+    plane from the CLI): ask the head to fan a bounded stack/XLA
+    sampling window to every selected process, write the merged bundle
+    (+ flamegraph-ready .folded sidecar), and summarize it."""
+    if args.summarize:
+        with open(args.summarize) as f:
+            _print_profile_summary(json.load(f), top=args.top)
+        return
+    address = _resolve_address(args)
+    conn = _connect(address)
+    try:
+        reply = conn.request(
+            {"kind": "profile_capture", "duration_s": args.duration,
+             "target": args.target, "hz": args.hz},
+            timeout=args.duration + 60.0)
+    finally:
+        conn.close()
+    bundle = reply["bundle"]
+    out = args.out or f"ray-tpu-profile-{int(time.time())}.json"
+    with open(out, "w") as f:
+        json.dump(bundle, f, default=str)
+    base = out[:-5] if out.endswith(".json") else out
+    folded_path = base + ".folded"
+    with open(folded_path, "w") as f:
+        for p in bundle.get("processes") or []:
+            prefix = f"{p.get('role', '?')}:{p.get('pid', '?')}"
+            for stack, count in sorted((p.get("folded") or {}).items()):
+                f.write(f"{prefix};{stack} {count}\n")
+    print(f"wrote {out} (load trace_events in chrome://tracing / "
+          f"Perfetto alongside `timeline`)")
+    print(f"wrote {folded_path} (flamegraph.pl / speedscope input)")
+    _print_profile_summary(bundle, top=args.top)
 
 
 def cmd_memory(args):
@@ -658,6 +743,31 @@ def main(argv=None):
                      "excepthook write it)")
     p.add_argument("path", help="flight-recorder JSON file")
     p.set_defaults(fn=cmd_dump)
+
+    p = sub.add_parser(
+        "profile", help="coordinated cluster capture: stack-sample "
+                        "(+XLA-trace) every selected process for a "
+                        "bounded window, merge into one bundle")
+    p.add_argument("--address", default=None)
+    p.add_argument("--duration", type=float, default=2.0,
+                   help="capture window seconds (clamped to "
+                        "RAY_TPU_PROFILE_MAX_S)")
+    p.add_argument("--target", default="all",
+                   help="all | head | workers | drivers | nodes | "
+                        "learner (device-owning processes) | a "
+                        "process addr")
+    p.add_argument("--hz", type=float, default=None,
+                   help="sampling frequency (default "
+                        "RAY_TPU_PROFILE_HZ)")
+    p.add_argument("--out", default=None,
+                   help="bundle JSON path (a .folded flamegraph "
+                        "sidecar is written next to it)")
+    p.add_argument("--top", type=int, default=8,
+                   help="frames per process in the summary")
+    p.add_argument("--summarize", default=None, metavar="BUNDLE",
+                   help="pretty-print an existing bundle JSON instead "
+                        "of capturing")
+    p.set_defaults(fn=cmd_profile)
 
     args = parser.parse_args(argv)
     args.fn(args)
