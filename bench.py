@@ -118,9 +118,15 @@ def bench_kernel(n_dev: int, curve_minibatches=(128, 512, 1024, 2048)):
     shows where the update leaves the HBM-bound regime.
 
     Returns (rate, mfu_pct, train_flops_per_row, fwd_flops_per_row,
-    curve)."""
+    curve, extras). The headline (rate, mfu_pct) is the per-chip
+    minibatch 1024 operating point — the roofline analysis (PERF.md
+    round 6) puts the 40% MFU gate at mb >= 1024; the r4-r6 256-row
+    point stays in `extras["kernel_per_chip_mb256"]` for continuity.
+    `extras["allreduce_bytes_per_update"]` carries the collective-plane
+    accounting (fp32 vs q8 payload + timed standalone probes)."""
     import jax
     from __graft_entry__ import _synthetic_ppo_batch
+    from ray_tpu.parallel import collectives
     from ray_tpu.parallel import mesh as mesh_lib
     from ray_tpu.rllib.agents.ppo.ppo import DEFAULT_CONFIG, PPOJaxPolicy
     from ray_tpu.rllib.env.spaces import Box, Discrete
@@ -149,7 +155,7 @@ def bench_kernel(n_dev: int, curve_minibatches=(128, 512, 1024, 2048)):
         policy._train_fn,
         jax.tree.map(lambda x: x.copy(), policy.params),
         jax.tree.map(lambda x: x.copy(), policy.opt_state),
-        dev_batch, rng, policy.loss_state)
+        policy._ef_state, dev_batch, rng, policy.loss_state)
     train_flops_per_row = train_flops / batch_size if train_flops else 0.0
     obs_probe = np.zeros((256,) + obs_shape, np.uint8)
     fwd_flops = compiled_flops(
@@ -170,14 +176,15 @@ def bench_kernel(n_dev: int, curve_minibatches=(128, 512, 1024, 2048)):
             params = jax.tree.map(lambda x: x.copy(), policy.params)
             opt_state = jax.tree.map(lambda x: x.copy(),
                                      policy.opt_state)
+            ef = jax.tree.map(lambda x: x.copy(), policy._ef_state)
             for _ in range(3):
-                params, opt_state, stats = update(
-                    params, opt_state, db, rng, policy.loss_state)
+                params, opt_state, ef, stats = update(
+                    params, opt_state, ef, db, rng, policy.loss_state)
             float(stats["total_loss"])  # sync
             t0 = time.perf_counter()
             for _ in range(iters):
-                params, opt_state, stats = update(
-                    params, opt_state, db, rng, policy.loss_state)
+                params, opt_state, ef, stats = update(
+                    params, opt_state, ef, db, rng, policy.loss_state)
             float(stats["total_loss"])  # readback forces completion
             return (time.perf_counter() - t0) / iters
 
@@ -187,25 +194,50 @@ def bench_kernel(n_dev: int, curve_minibatches=(128, 512, 1024, 2048)):
         marginal = max(1e-9, (t_hi - t_lo) / (e_hi - e_lo))
         return bs / marginal / n_dev
 
-    # Headline point: unchanged r4/r5 shape (4 x 256-row minibatches
-    # per chip) for round-over-round continuity.
-    rate = marginal_rate(256)
-    mfu = None
-    if peak and train_flops_per_row:
-        mfu = 100.0 * train_flops_per_row * rate / peak
+    def point(mb: int, rate: float) -> dict:
+        return {"minibatch_per_chip": mb,
+                "rows_per_s_per_chip": round(rate, 1),
+                "mfu_pct": (round(
+                    100.0 * train_flops_per_row * rate / peak, 2)
+                    if peak and train_flops_per_row else None)}
 
-    curve = [{"minibatch_per_chip": 256,
-              "rows_per_s_per_chip": round(rate, 1),
-              "mfu_pct": round(mfu, 2) if mfu is not None else None}]
+    # mb 256 is the r4-r6 continuity point; the headline moves to the
+    # big-batch operating point below.
+    rate256 = marginal_rate(256)
+    curve = [point(256, rate256)]
     for mb in curve_minibatches:
-        r = marginal_rate(mb, iters=6)
-        curve.append({
-            "minibatch_per_chip": mb,
-            "rows_per_s_per_chip": round(r, 1),
-            "mfu_pct": (round(100.0 * train_flops_per_row * r / peak, 2)
-                        if peak and train_flops_per_row else None)})
+        curve.append(point(mb, marginal_rate(mb, iters=6)))
     curve.sort(key=lambda p: p["minibatch_per_chip"])
-    return rate, mfu, train_flops_per_row, fwd_flops_per_row, curve
+
+    # Headline operating point: per-chip minibatch 1024 (the smallest
+    # point past the roofline's arithmetic-intensity knee).
+    headline_mb = 1024
+    headline = next(p for p in curve
+                    if p["minibatch_per_chip"] == headline_mb)
+    rate = headline["rows_per_s_per_chip"]
+    mfu = headline["mfu_pct"]
+
+    # Collective-plane accounting: per-sender bytes one gradient
+    # all-reduce of this param tree puts on the wire under each codec
+    # (analytic), plus a timed standalone exchange per codec when the
+    # mesh is real.
+    allreduce = {
+        "fp32": collectives.payload_bytes(policy.params, "fp32"),
+        "q8": collectives.payload_bytes(policy.params, "q8"),
+    }
+    allreduce["ratio"] = round(allreduce["fp32"] / allreduce["q8"], 2)
+    if n_dev >= 2:
+        for codec in ("fp32", "q8"):
+            allreduce[f"{codec}_probe_ms"] = round(
+                1e3 * collectives.allreduce_probe_s(
+                    policy.params, mesh, codec), 3)
+    extras = {
+        "headline_minibatch_per_chip": headline_mb,
+        "kernel_per_chip_mb256": round(rate256, 1),
+        "allreduce_bytes_per_update": allreduce,
+    }
+    return (rate, mfu, train_flops_per_row, fwd_flops_per_row, curve,
+            extras)
 
 
 def bench_anakin(n_dev: int, flops_per_step: float = 0.0):
@@ -535,8 +567,8 @@ def sweep_sebulba_points(n_dev: int, n_actors: int, n_envs: int,
 def main():
     import jax
     n_dev = len(jax.devices())
-    kernel, kernel_mfu, train_fpr, fwd_fpr, mfu_curve = bench_kernel(
-        n_dev)
+    (kernel, kernel_mfu, train_fpr, fwd_fpr, mfu_curve,
+     kernel_extras) = bench_kernel(n_dev)
     anakin, anakin_sd, reward, anakin_mfu, telemetry = bench_anakin(
         n_dev, flops_per_step=train_fpr + fwd_fpr)
     # Operating-point sweep (1 window each), then the full headline at
@@ -595,10 +627,20 @@ def main():
                                   "link-bound on this host by design)",
         "kernel_per_chip": round(kernel, 1),
         "kernel_vs_baseline": round(kernel / BASELINE_PER_CHIP, 3),
-        "kernel_note": "marginal fused-epoch rate w/ forced readback",
+        "kernel_note": "marginal fused-epoch rate w/ forced readback; "
+                       "headline at per-chip minibatch "
+                       f"{kernel_extras['headline_minibatch_per_chip']} "
+                       "(roofline operating point, r07+); "
+                       "kernel_per_chip_mb256 is the r4-r6 continuity "
+                       "line",
+        "kernel_per_chip_mb256": kernel_extras["kernel_per_chip_mb256"],
         # Per-chip minibatch-size -> MFU curve (roofline companion,
         # PERF.md round 8; per-row FLOPs constant across points).
         "kernel_mfu_curve": mfu_curve,
+        # Per-sender gradient all-reduce payload per codec (analytic
+        # bytes + timed standalone probes; parallel/collectives.py).
+        "allreduce_bytes_per_update":
+            kernel_extras["allreduce_bytes_per_update"],
         # Encoder-level weight-sync cost on the flagship tree (bytes a
         # worker receives per broadcast, per codec arm) — the delta
         # plane's r06+ trajectory line.

@@ -202,6 +202,13 @@ def wire_decode(codec: int, payload, base=None):
 # learning curves on the full-sync trajectory.
 # ---------------------------------------------------------------------
 Q8_BLOCK = 1024
+# Positive floor for per-block scales. An all-zero block has amax 0; a
+# zero scale would round-trip 0/0 = NaN through dequantize on any
+# nonzero quantized value, so every scale is clamped here (and in the
+# jnp mirror, parallel/collectives.py) to this epsilon. Zero blocks
+# still reconstruct to exactly 0.0 (q == 0 either way), so the clamp
+# changes no payload semantics — it only removes the zero-scale case.
+Q8_SCALE_EPS = 1e-30
 _Q8HDR = struct.Struct("<I")
 
 
@@ -214,9 +221,8 @@ def q8_quantize(vec):
     padded = np.zeros(nb * Q8_BLOCK, np.float32)
     padded[:n] = vec
     blocks = padded.reshape(nb, Q8_BLOCK)
-    scales = np.abs(blocks).max(axis=1) / 127.0
-    scales[scales == 0.0] = 1.0
-    scales = scales.astype(np.float32)
+    scales = np.maximum(np.abs(blocks).max(axis=1) / 127.0,
+                        Q8_SCALE_EPS).astype(np.float32)
     q = np.clip(np.rint(blocks / scales[:, None]), -127, 127) \
         .astype(np.int8)
     return q.reshape(-1)[:n].copy(), scales

@@ -25,7 +25,24 @@ MODEL_DEFAULTS = {
     "lstm_cell_size": 256,
     "max_seq_len": 20,
     "framework": "jax",
+    # Trunk compute dtype: "auto" defers to RAY_TPU_COMPUTE_DTYPE. At
+    # the default f32 each network keeps its own default (the Vision
+    # trunk stays bf16 for the MXU); "bf16"/"f32" force it everywhere.
+    "compute_dtype": "auto",
 }
+
+
+def _resolve_compute_dtype(cfg):
+    """MODEL_DEFAULTS["compute_dtype"] -> jnp dtype or None (= keep
+    each network's own default)."""
+    value = cfg.get("compute_dtype", "auto")
+    explicit = value not in (None, "auto")
+    from ..parallel import collectives
+    dtype = collectives.resolve_compute_dtype(value)
+    import jax.numpy as jnp
+    if not explicit and dtype == jnp.float32:
+        return None
+    return dtype
 
 
 class Preprocessor:
@@ -89,17 +106,20 @@ def get_model(obs_space, num_outputs: int, model_config: dict = None):
             cell_size=cfg["lstm_cell_size"],
             hiddens=tuple(cfg["fcnet_hiddens"]),
             activation=cfg["fcnet_activation"])
+    dtype = _resolve_compute_dtype(cfg)
     if is_image_space(obs_space):
         filters = cfg["conv_filters"] or ((32, 8, 4), (64, 4, 2), (64, 3, 1))
+        kwargs = {} if dtype is None else {"compute_dtype": dtype}
         return VisionNetwork(
             num_outputs=num_outputs,
-            conv_filters=tuple(tuple(f) for f in filters))
+            conv_filters=tuple(tuple(f) for f in filters), **kwargs)
+    kwargs = {} if dtype is None else {"compute_dtype": dtype}
     return FullyConnectedNetwork(
         num_outputs=num_outputs,
         hiddens=tuple(cfg["fcnet_hiddens"]),
         activation=cfg["fcnet_activation"],
         vf_share_layers=cfg["vf_share_layers"],
-        free_log_std=cfg["free_log_std"])
+        free_log_std=cfg["free_log_std"], **kwargs)
 
 
 def observation_shape(obs_space) -> Tuple[int, ...]:

@@ -34,15 +34,20 @@ class FullyConnectedNetwork(nn.Module):
     activation: str = "tanh"
     vf_share_layers: bool = False
     free_log_std: bool = False  # Box policies: state-independent log_std
+    # Trunk compute dtype (RAY_TPU_COMPUTE_DTYPE via catalog): params
+    # stay f32 (flax casts per-layer); logits/value heads compute f32.
+    compute_dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, obs):
         act = _activation(self.activation)
-        x = obs.reshape(obs.shape[0], -1).astype(jnp.float32)
+        x = obs.reshape(obs.shape[0], -1).astype(self.compute_dtype)
 
         h = x
         for i, size in enumerate(self.hiddens):
-            h = act(nn.Dense(size, name=f"fc_{i}")(h))
+            h = act(nn.Dense(size, name=f"fc_{i}",
+                             dtype=self.compute_dtype)(h))
+        h = h.astype(jnp.float32)
         num_out = self.num_outputs // 2 if self.free_log_std \
             else self.num_outputs
         logits = nn.Dense(num_out, name="logits",
@@ -58,7 +63,9 @@ class FullyConnectedNetwork(nn.Module):
         else:
             v = x
             for i, size in enumerate(self.hiddens):
-                v = act(nn.Dense(size, name=f"vf_{i}")(v))
+                v = act(nn.Dense(size, name=f"vf_{i}",
+                                 dtype=self.compute_dtype)(v))
+            v = v.astype(jnp.float32)
             value = nn.Dense(1, name="value")(v)
         return logits, value[..., 0]
 

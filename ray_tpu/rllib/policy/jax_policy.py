@@ -16,12 +16,17 @@ for XLA:
   (`rllib/optimizers/multi_gpu_impl.py:225`).
 - On a multi-device mesh, parameters are replicated and batches sharded on
   the "dp" axis; XLA inserts gradient all-reduces over ICI (the replacement
-  for in-graph tower averaging, `multi_gpu_impl.py:310`).
+  for in-graph tower averaging, `multi_gpu_impl.py:310`). The
+  `allreduce_codec` knob swaps that implicit fp32 psum for the explicit
+  q8 block-quantized exchange (parallel/collectives.py), and
+  `compute_dtype` runs the forward/backward in bf16 against fp32 master
+  weights.
 """
 
 from __future__ import annotations
 
 import functools
+import logging
 import threading
 from typing import Callable, Dict, Optional
 
@@ -29,12 +34,16 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax.sharding import PartitionSpec as P
 
 from ...models import catalog
 from ...models.distributions import get_action_dist
+from ...parallel import collectives
 from ...parallel import mesh as mesh_lib
 from .. import sample_batch as sb
 from .policy import Policy
+
+logger = logging.getLogger(__name__)
 
 # Columns that the device-side loss consumes; everything else stays host-side.
 _DEVICE_COLUMNS = (
@@ -73,11 +82,21 @@ class JaxPolicy(Policy):
                  seed: Optional[int] = None):
         super().__init__(observation_space, action_space, config)
         self.dist_class, self.dist_dim = get_action_dist(action_space)
+        # Compute dtype resolves BEFORE the model is built so catalog
+        # networks thread it through their flax layers (bf16 trunk
+        # activations, not just bf16-cast weights). Custom make_model
+        # models still get bf16 weights via the loss-boundary cast.
+        self.compute_dtype = collectives.resolve_compute_dtype(
+            config.get("compute_dtype", "auto"))
         if make_model is not None:
             self.model = make_model(observation_space, action_space, config)
         else:
+            mcfg = dict(config.get("model") or {})
+            if mcfg.get("compute_dtype", "auto") in (None, "auto") \
+                    and self.compute_dtype == jnp.bfloat16:
+                mcfg["compute_dtype"] = "bf16"
             self.model = catalog.get_model(
-                observation_space, self.dist_dim, config.get("model"))
+                observation_space, self.dist_dim, mcfg)
         self._loss_fn = loss_fn
         self._postprocess_fn = postprocess_fn
         self._extra_action_out_fn = extra_action_out_fn
@@ -136,6 +155,34 @@ class JaxPolicy(Policy):
         self.opt_state = jax.device_put(self.opt_state, self._opt_sh)
         self._repl = mesh_lib.replicated(self.mesh)
         self._bsharded = mesh_lib.batch_sharded(self.mesh)
+
+        # Collective plane (parallel/collectives.py): the gradient
+        # exchange codec. The q8 all-reduce quantizes each sender's
+        # FULL local gradient, so it needs replicated params and a real
+        # mesh; anything else falls back to the implicit fp32 psum
+        # (which is also the byte-identical legacy program).
+        codec = collectives.resolve_codec(
+            config.get("allreduce_codec", "auto"))
+        ndev = int(self.mesh.shape[self.layout.batch_axis])
+        if codec == "q8" and (ndev < 2 or not self.layout.is_replicated()):
+            if ndev >= 2:
+                logger.warning(
+                    "allreduce_codec=q8 needs replicated params; the %r "
+                    "sharding table splits them — falling back to fp32",
+                    table)
+            codec = "fp32"
+        self.allreduce_codec = codec
+        # Per-replica error-feedback residuals, stacked on a leading
+        # mesh-sharded axis ({} for fp32: no residual to carry).
+        self._ef_state = (
+            collectives.ef_zeros(self.params, self.mesh,
+                                 self.layout.batch_axis)
+            if codec == "q8" else {})
+        self._ef_sh = collectives.ef_sharding(
+            self.mesh, self.layout.batch_axis)
+        self._allreduce_payload = collectives.payload_bytes(
+            self.params, codec)
+        self._allreduce_probe = None
 
         # Mutable device scalars consumed by the loss (adaptive KL etc.).
         self.loss_state: Dict = {
@@ -239,29 +286,78 @@ class JaxPolicy(Policy):
             self._value_fn = jax.jit(
                 lambda params, obs: self.apply(params, obs)[1])
 
-        def loss_and_grad(params, batch, rng, loss_state):
+        # One local loss+grad, shared by every learn path. bf16 compute
+        # casts the f32 master params at this boundary only: autodiff
+        # transposes the cast, so gradients (and optax state) stay f32.
+        cdt = self.compute_dtype
+        codec = self.allreduce_codec
+        axis = self.layout.batch_axis
+        ndev = int(self.mesh.shape[axis])
+
+        def local_loss_grad(params, batch, rng, loss_state):
+            def lf(p):
+                if cdt != jnp.float32:
+                    p = collectives.cast_float_tree(p, cdt)
+                return self._loss_fn(self, p, batch, rng, loss_state)
             (loss, stats), grads = jax.value_and_grad(
-                self._loss_fn, argnums=1, has_aux=True)(
-                    self, params, batch, rng, loss_state)
+                lf, has_aux=True)(params)
             return loss, stats, grads
 
-        def train_fn(params, opt_state, batch, rng, loss_state):
-            loss, stats, grads = loss_and_grad(params, batch, rng, loss_state)
+        # loss_grad(params, batch, rng, loss_state, ef) ->
+        # (loss, stats, grads, ef): the collective seam. fp32 keeps the
+        # legacy implicit psum (XLA reduces grads from batch sharding);
+        # q8 makes the exchange explicit via shard_map so each sender
+        # quantizes (grad + carried residual) before it travels.
+        if codec == "q8":
+            from jax.experimental.shard_map import shard_map
+
+            def loss_grad(params, batch, rng, loss_state, ef):
+                def per_replica(params, batch, rng, loss_state, ef):
+                    ef = jax.tree.map(lambda e: e[0], ef)
+                    loss, stats, grads = local_loss_grad(
+                        params, batch, rng, loss_state)
+                    grads, ef = collectives.pmean_quantized(
+                        grads, ef, axis, ndev)
+                    loss, stats = jax.lax.pmean(
+                        (loss, dict(stats)), axis)
+                    return loss, stats, grads, jax.tree.map(
+                        lambda e: e[None], ef)
+                # check_rep=False: the summed output IS replicated
+                # (every replica sums the same gathered payload) but
+                # shard_map cannot infer that through all_gather + sum.
+                return shard_map(
+                    per_replica, mesh=self.mesh,
+                    in_specs=(P(), P(axis), P(), P(), P(axis)),
+                    out_specs=(P(), P(), P(), P(axis)),
+                    check_rep=False)(params, batch, rng, loss_state, ef)
+        else:
+            def loss_grad(params, batch, rng, loss_state, ef):
+                loss, stats, grads = local_loss_grad(
+                    params, batch, rng, loss_state)
+                return loss, dict(stats), grads, ef
+
+        self._loss_grad = loss_grad
+
+        def train_fn(params, opt_state, ef, batch, rng, loss_state):
+            loss, stats, grads, ef = loss_grad(
+                params, batch, rng, loss_state, ef)
             updates, opt_state = self.optimizer.update(
                 grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             stats = dict(stats)
             stats["grad_gnorm"] = optax.global_norm(grads)
-            return params, opt_state, stats
+            return params, opt_state, ef, stats
 
         self._train_fn = jax.jit(
-            train_fn, donate_argnums=(0, 1),
-            in_shardings=(self._param_sh, self._opt_sh, self._bsharded,
-                          self._repl, self._repl),
-            out_shardings=(self._param_sh, self._opt_sh, self._repl))
+            train_fn, donate_argnums=(0, 1, 2),
+            in_shardings=(self._param_sh, self._opt_sh, self._ef_sh,
+                          self._bsharded, self._repl, self._repl),
+            out_shardings=(self._param_sh, self._opt_sh, self._ef_sh,
+                           self._repl))
 
         def grad_fn(params, batch, rng, loss_state):
-            loss, stats, grads = loss_and_grad(params, batch, rng, loss_state)
+            loss, stats, grads = local_loss_grad(
+                params, batch, rng, loss_state)
             stats = dict(stats)
             return grads, stats
 
@@ -394,9 +490,11 @@ class JaxPolicy(Policy):
     def learn_on_batch(self, batch) -> Dict:
         dev_batch = self._device_batch(batch)
         with self._update_lock:
-            self.params, self.opt_state, stats = self._train_fn(
-                self.params, self.opt_state, dev_batch, self._next_rng(),
-                self.loss_state)
+            self.params, self.opt_state, self._ef_state, stats = \
+                self._train_fn(
+                    self.params, self.opt_state, self._ef_state, dev_batch,
+                    self._next_rng(), self.loss_state)
+        self._account_allreduce(1)
         self.global_timestep += batch.count if hasattr(batch, "count") \
             else len(next(iter(batch.values())))
         return {k: float(v) for k, v in stats.items()}
@@ -441,21 +539,40 @@ class JaxPolicy(Policy):
         if key not in self._sgd_fns:
             self._sgd_fns[key] = self._make_sgd_fn(*key)
         with self._update_lock:
-            self.params, self.opt_state, stats = self._sgd_fns[key](
-                self.params, self.opt_state, dev_batch, self._next_rng(),
-                self.loss_state)
+            self.params, self.opt_state, self._ef_state, stats = \
+                self._sgd_fns[key](
+                    self.params, self.opt_state, self._ef_state, dev_batch,
+                    self._next_rng(), self.loss_state)
+        self._account_allreduce(num_sgd_iter * num_mb)
         from ..sample_batch import real_count
         self.global_timestep += real_count(batch)
         return {k: float(v) for k, v in stats.items()}
 
+    def _account_allreduce(self, n_updates: int) -> None:
+        """Collective-plane accounting for `n_updates` gradient
+        exchanges: `allreduce_bytes` is analytic (per-sender payload of
+        one all-reduce of the param-shaped grad tree under the active
+        codec); `allreduce_ms` / the `learner_allreduce_s.<codec>`
+        histogram come from a once-per-policy timed standalone probe —
+        a collective fused into the update program cannot be timed from
+        the host, so the estimate is measured on grad-shaped zeros."""
+        if int(self.mesh.shape[self.layout.batch_axis]) < 2:
+            return
+        if self._allreduce_probe is None:
+            self._allreduce_probe = collectives.allreduce_probe_s(
+                self.params, self.mesh, self.allreduce_codec,
+                self.layout.batch_axis)
+        collectives.account(self.allreduce_codec, self._allreduce_payload,
+                            n_updates, self._allreduce_probe)
+
     def _make_sgd_fn(self, num_sgd_iter: int, num_mb: int, mb_size: int,
                      seq_len: int = 1):
-        def sgd_fn(params, opt_state, batch, rng, loss_state):
+        def sgd_fn(params, opt_state, ef, batch, rng, loss_state):
             usable = num_mb * mb_size
             num_seq = usable // seq_len
 
             def epoch(carry, erng):
-                params, opt_state = carry
+                params, opt_state, ef = carry
                 # Permute whole sequences: rows within a seq_len block stay
                 # contiguous (seq_len=1 degenerates to row shuffling).
                 perm = jax.random.permutation(erng, num_seq)
@@ -475,32 +592,33 @@ class JaxPolicy(Policy):
                         (num_mb, mb_size // seq_len) + boot.shape[1:])
 
                 def mb_step(carry, mb):
-                    params, opt_state = carry
-                    (loss, stats), grads = jax.value_and_grad(
-                        self._loss_fn, argnums=1, has_aux=True)(
-                            self, params, mb, erng, loss_state)
+                    params, opt_state, ef = carry
+                    loss, stats, grads, ef = self._loss_grad(
+                        params, mb, erng, loss_state, ef)
                     updates, opt_state = self.optimizer.update(
                         grads, opt_state, params)
                     params = optax.apply_updates(params, updates)
                     stats = dict(stats)
                     stats["grad_gnorm"] = optax.global_norm(grads)
-                    return (params, opt_state), stats
+                    return (params, opt_state, ef), stats
 
-                (params, opt_state), stats = jax.lax.scan(
-                    mb_step, (params, opt_state), mbs)
-                return (params, opt_state), jax.tree.map(
+                (params, opt_state, ef), stats = jax.lax.scan(
+                    mb_step, (params, opt_state, ef), mbs)
+                return (params, opt_state, ef), jax.tree.map(
                     lambda s: s[-1], stats)  # stats of last minibatch
 
             rngs = jax.random.split(rng, num_sgd_iter)
-            (params, opt_state), stats = jax.lax.scan(
-                epoch, (params, opt_state), rngs)
-            return params, opt_state, jax.tree.map(lambda s: s[-1], stats)
+            (params, opt_state, ef), stats = jax.lax.scan(
+                epoch, (params, opt_state, ef), rngs)
+            return params, opt_state, ef, jax.tree.map(
+                lambda s: s[-1], stats)
 
         return jax.jit(
-            sgd_fn, donate_argnums=(0, 1),
-            in_shardings=(self._param_sh, self._opt_sh, self._bsharded,
-                          self._repl, self._repl),
-            out_shardings=(self._param_sh, self._opt_sh, self._repl))
+            sgd_fn, donate_argnums=(0, 1, 2),
+            in_shardings=(self._param_sh, self._opt_sh, self._ef_sh,
+                          self._bsharded, self._repl, self._repl),
+            out_shardings=(self._param_sh, self._opt_sh, self._ef_sh,
+                           self._repl))
 
     def compute_gradients(self, batch):
         dev_batch = self._device_batch(batch)
