@@ -221,6 +221,18 @@ _def("RAY_TPU_LOCKCHECK", bool, False,
      "mode): real acquisition orders are recorded per thread and "
      "inversions surface via graftcheck.runtime_trace.get_violations()."
      " Test-time knob; off = plain threading locks, zero overhead")
+_def("RAY_TPU_RACECHECK", bool, False,
+     "Arm the Eraser-style lockset data-race detector (graftcheck "
+     "GC300 plane): hot shared containers are wrapped in access-"
+     "recording proxies and writes that no common lock protects "
+     "surface as GC301/GC302 findings via graftcheck.racecheck."
+     "get_findings(). Also arms the traced locks of RAY_TPU_LOCKCHECK "
+     "(locksets need them). Test-time knob; off = raw containers, "
+     "zero added indirection")
+_def("RAY_TPU_RACE_STRESS_SEED", int, 1234,
+     "Default seed for the deterministic interleaving stress harness "
+     "(graftcheck/stress.py; `ray_tpu.scripts check --race`). The "
+     "same seed replays the same per-thread op scripts byte-for-byte")
 
 # --- native components ------------------------------------------------
 _def("RAY_TPU_NATIVE", bool, True,

@@ -1,6 +1,6 @@
 """graftcheck: framework-aware static analysis for the ray_tpu tree.
 
-Two halves (see README "Correctness tooling"):
+Three planes (see README "Correctness tooling"):
 
 - an AST lint pass with rules for distributed anti-patterns (blocking
   ``ray_tpu.get`` inside remote code, large literals captured in remote
@@ -11,7 +11,13 @@ Two halves (see README "Correctness tooling"):
   over the runtime modules with cycle detection (``lockgraph.py``),
   plus an env-gated runtime tracer (``RAY_TPU_LOCKCHECK=1``,
   ``runtime_trace.py``) that records real acquisition orders and flags
-  inversions while tests run.
+  inversions while tests run;
+- a data-race plane: an env-gated (``RAY_TPU_RACECHECK=1``) Eraser-
+  style lockset detector over the runtime's hot shared containers
+  (``racecheck.py``, GC301/GC302) plus a seeded deterministic
+  interleaving stress harness that drives real thread interleavings
+  through a live runtime with the detector armed (``stress.py``,
+  ``scripts check --race [--stress SEED]``).
 
 Findings are structured (rule id, path:line, severity), support a
 checked-in suppression baseline, and the CLI
@@ -25,12 +31,12 @@ from __future__ import annotations
 from .findings import Baseline, Finding, load_inline_suppressions
 from .rules import ModuleContext, RULE_REGISTRY, iter_py_files, run_lint
 from .lockgraph import LockGraph, analyze_lock_order
-from . import runtime_trace
+from . import racecheck, runtime_trace
 
 __all__ = [
     "Baseline", "Finding", "LockGraph", "ModuleContext", "RULE_REGISTRY",
     "analyze_lock_order", "iter_py_files", "load_inline_suppressions",
-    "run_check", "run_lint", "runtime_trace",
+    "racecheck", "run_check", "run_lint", "runtime_trace",
 ]
 
 

@@ -2,6 +2,16 @@
 
     python -m ray_tpu.scripts check [paths...]
         [--baseline FILE] [--write-baseline] [--json] [--no-lockgraph]
+        [--race] [--stress SEED]
+
+`--race` additionally arms the GC300 lockset data-race plane: a live
+runtime is spun up and the seeded interleaving stress harness
+(graftcheck/stress.py) races mixed put/get/del/borrow/kill/evict
+scripts through it with access-recording proxies on the hot shared
+tables; GC301/GC302 findings join the stream and go through the same
+baseline/inline suppression. `--stress SEED` (implies --race) pins the
+seed and also verifies the trace replays byte-identical — the same
+determinism gate `scripts chaos --replay` applies to fault injection.
 
 Exit status: 0 when no unsuppressed findings, 1 otherwise. The
 shipped tree passes clean; `tests/test_graftcheck.py::test_self_clean`
@@ -22,7 +32,8 @@ from .reporter import print_json, print_text
 
 def run(paths: List[str], baseline_path: Optional[str] = None,
         write_baseline: bool = False, as_json: bool = False,
-        lockgraph: bool = True, stream=None) -> int:
+        lockgraph: bool = True, race: bool = False,
+        stress_seed: Optional[int] = None, stream=None) -> int:
     paths = paths or ["ray_tpu"]
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
@@ -35,6 +46,11 @@ def run(paths: List[str], baseline_path: Optional[str] = None,
         baseline = Baseline.find_default(paths)
     new, suppressed = run_check(paths, baseline=baseline,
                                 lockgraph=lockgraph)
+    if race or stress_seed is not None:
+        rc = _run_race_leg(baseline, stress_seed, new, suppressed,
+                           stream=stream)
+        if rc:
+            return rc
     if write_baseline:
         out = baseline_path or baseline.path \
             or os.path.join(os.getcwd(), ".graftcheck-baseline.json")
@@ -49,6 +65,50 @@ def run(paths: List[str], baseline_path: Optional[str] = None,
     else:
         print_text(new, suppressed, stream=stream)
     return 1 if new else 0
+
+
+def _run_race_leg(baseline: Baseline, stress_seed: Optional[int],
+                  new: list, suppressed: list, stream=None) -> int:
+    """Arm racecheck, drive the interleaving stress harness against a
+    live runtime, and fold GC30x findings into the stream. Returns a
+    non-zero exit code for harness-level failures (dead canary,
+    divergent replay); finding-level failures flow through `new`."""
+    from . import stress
+    out = stream or sys.stdout
+    verify = stress_seed is not None
+    try:
+        if verify:
+            result = stress.verify_replay(stress_seed)
+        else:
+            result = stress.run_stress()
+    except Exception as e:  # noqa: BLE001 - CLI boundary
+        print(f"graftcheck: race stress harness failed: "
+              f"{type(e).__name__}: {e}", file=stream or sys.stderr)
+        return 2
+    print(f"graftcheck: race stress seed={result['seed']} "
+          f"threads={result['threads']} "
+          f"ops/thread={result['ops_per_thread']} "
+          f"trace={len(result['trace'])} entries", file=out)
+    if not result["canary_ok"]:
+        print("graftcheck: race canary NOT detected — the lockset "
+              "detector is not arming; refusing a vacuous pass",
+              file=stream or sys.stderr)
+        return 2
+    print("graftcheck: planted-race canary detected (GC301)", file=out)
+    if verify:
+        if not result["replay_identical"]:
+            print(f"graftcheck: stress trace DIVERGED on replay of "
+                  f"seed {result['seed']}", file=stream or sys.stderr)
+            return 2
+        print(f"graftcheck: replay of seed {result['seed']} is "
+              f"byte-identical ({len(result['trace_bytes'])} bytes)",
+              file=out)
+    for f in result["findings"]:
+        if baseline.matches(f) or f.inline_suppressed:
+            suppressed.append(f)
+        else:
+            new.append(f)
+    return 0
 
 
 def main(argv=None) -> int:
@@ -70,10 +130,20 @@ def main(argv=None) -> int:
                         help="machine-readable output")
     parser.add_argument("--no-lockgraph", action="store_true",
                         help="skip the static lock-order pass")
+    parser.add_argument("--race", action="store_true",
+                        help="also run the GC300 lockset race plane: "
+                             "seeded interleaving stress against a "
+                             "live runtime with racecheck armed")
+    parser.add_argument("--stress", type=int, default=None,
+                        metavar="SEED",
+                        help="race-stress seed (implies --race); also "
+                             "verifies the trace replays "
+                             "byte-identical from the seed")
     args = parser.parse_args(argv)
     return run(args.paths, baseline_path=args.baseline,
                write_baseline=args.write_baseline, as_json=args.json,
-               lockgraph=not args.no_lockgraph)
+               lockgraph=not args.no_lockgraph, race=args.race,
+               stress_seed=args.stress)
 
 
 if __name__ == "__main__":

@@ -23,6 +23,15 @@ Rule catalog (ids are stable; see README "Correctness tooling"):
   handler retries (``continue``) with no bound or backoff anywhere in
   the loop hot-spins forever against a persistent failure; route it
   through ``_private/backoff.Backoff`` (or any sleep/wait/timeout).
+- GC108 mixed-lock-discipline: an instance attribute is mutated both
+  under ``with self.<lock>`` and bare (outside ``__init__``) in the
+  same class — the bare write races every locked reader/writer; the
+  static shadow of the GC301 lockset finding.
+- GC109 blocking-call-under-lock: a blocking call (``time.sleep``,
+  thread ``.join``, socket recv/accept/connect/sendall,
+  ``ray_tpu.get``/``wait``) lexically inside a ``with self.<lock>``
+  block stalls every thread contending for that lock — the convoy/
+  deadlock shape behind both hand-found `_TransferPool` wedges.
 """
 
 from __future__ import annotations
@@ -319,6 +328,229 @@ class UnboundedRetryLoop(Rule):
                         "the loop sleeps, waits, or bounds attempts; "
                         "use _private/backoff.Backoff (raise when "
                         "sleep() returns False)")
+
+
+# Substrings marking a name as a mutex-like guard (`self._lock`,
+# `send_mutex`, `self._cv`...). Shared by GC108/GC109.
+_LOCKISH_MARKERS = ("lock", "mutex", "cv", "cond")
+
+
+def _lockish_name(name: str) -> bool:
+    low = name.lower()
+    return any(m in low for m in _LOCKISH_MARKERS)
+
+
+def _lockish_with_item(item: ast.withitem) -> bool:
+    """`with self._lock:` / `with send_lock:` shapes (the guard must be
+    named like one; `with open(...)` and friends don't count)."""
+    e = item.context_expr
+    if isinstance(e, ast.Attribute):
+        return _lockish_name(e.attr)
+    if isinstance(e, ast.Name):
+        return _lockish_name(e.id)
+    return False
+
+
+def _enclosing_lockish_with(ctx: ModuleContext, node: ast.AST,
+                            stop: ast.AST = None):
+    """The nearest ancestor `with` holding a lockish guard, up to (not
+    through) `stop`; None when the node runs lock-free."""
+    cur = ctx.parents.get(node)
+    while cur is not None and cur is not stop:
+        if isinstance(cur, ast.With) \
+                and any(_lockish_with_item(i) for i in cur.items):
+            return cur
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Lambda)):
+            # A nested def under a lock runs later, not under the lock.
+            return None
+        cur = ctx.parents.get(cur)
+    return None
+
+
+# Container-mutator method names: `self.X.append(...)` counts as a
+# write to `self.X` for lock-discipline purposes.
+_MUTATOR_NAMES = frozenset(
+    {"append", "appendleft", "extend", "extendleft", "insert", "remove",
+     "pop", "popleft", "popitem", "clear", "add", "discard",
+     "setdefault", "move_to_end", "rotate", "sort", "reverse"})
+
+
+@register
+class MixedLockDiscipline(Rule):
+    id = "GC108"
+    severity = SEVERITY_WARNING
+    doc = ("instance attribute mutated both under a class lock and "
+           "bare — unsynchronized shared-field write")
+
+    @staticmethod
+    def _self_attr(node: ast.expr):
+        """`self.X` -> "X" (else None)."""
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return node.attr
+        return None
+
+    def _write_target(self, node: ast.AST):
+        """The `self.X` attribute a statement mutates, or None."""
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                attr = self._self_attr(t)
+                if attr is not None:
+                    return attr
+                if isinstance(t, ast.Subscript):
+                    attr = self._self_attr(t.value)
+                    if attr is not None:
+                        return attr
+            return None
+        if isinstance(node, ast.AugAssign):
+            attr = self._self_attr(node.target)
+            if attr is not None:
+                return attr
+            if isinstance(node.target, ast.Subscript):
+                return self._self_attr(node.target.value)
+            return None
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    attr = self._self_attr(t.value)
+                    if attr is not None:
+                        return attr
+            return None
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _MUTATOR_NAMES:
+            return self._self_attr(node.func.value)
+        return None
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for cls in ast.walk(ctx.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locked: dict = {}   # attr -> first locked write node
+            bare: dict = {}     # attr -> [bare write nodes]
+            for method in cls.body:
+                if not isinstance(method, (ast.FunctionDef,
+                                           ast.AsyncFunctionDef)):
+                    continue
+                if method.name == "__init__":
+                    continue  # single-threaded construction
+                # Repo convention: a `*_locked` method is called with
+                # the class lock already held — its writes are locked.
+                in_locked_method = method.name.endswith("_locked")
+                for node in ast.walk(method):
+                    attr = self._write_target(node)
+                    if attr is None or _lockish_name(attr):
+                        continue
+                    if in_locked_method \
+                            or _enclosing_lockish_with(
+                                ctx, node, stop=method) is not None:
+                        locked.setdefault(attr, node)
+                    else:
+                        bare.setdefault(attr, []).append(node)
+            for attr in sorted(set(locked) & set(bare)):
+                guard = locked[attr]
+                for node in bare[attr]:
+                    yield ctx.finding(
+                        self, node,
+                        f"'self.{attr}' is mutated under a lock at "
+                        f"{ctx.relpath}:{guard.lineno} but written "
+                        f"bare here: the bare write races every "
+                        f"locked access; take the same lock (or "
+                        f"document why this path is single-threaded)")
+
+
+@register
+class BlockingCallUnderLock(Rule):
+    id = "GC109"
+    severity = SEVERITY_WARNING
+    doc = ("blocking call (sleep/join/socket io/ray_tpu.get) while "
+           "holding a lock")
+
+    _SOCKET_BLOCKERS = frozenset(
+        {"recv", "recvall", "recv_into", "accept", "connect", "sendall"})
+
+    @staticmethod
+    def _is_numeric(node: ast.expr) -> bool:
+        return isinstance(node, ast.Constant) \
+            and isinstance(node.value, (int, float)) \
+            and not isinstance(node.value, bool)
+
+    def _blocking_reason(self, node: ast.Call, ctx: ModuleContext):
+        f = node.func
+        if not isinstance(f, ast.Attribute):
+            return None
+        recv = f.value
+        if f.attr == "sleep" and isinstance(recv, ast.Name) \
+                and recv.id == "time":
+            return "time.sleep()"
+        if f.attr in ("get", "wait") and isinstance(recv, ast.Name) \
+                and recv.id in ctx.ray_aliases:
+            return f"{recv.id}.{f.attr}()"
+        if f.attr in self._SOCKET_BLOCKERS:
+            return f".{f.attr}() socket io"
+        if f.attr == "join":
+            # Thread joins only: a Name or self-attr receiver with no
+            # argument or a numeric timeout — excludes ",".join(xs),
+            # os.path.join(a, b), and sep.join(parts).
+            plausible_thread = (
+                isinstance(recv, ast.Name)
+                or (isinstance(recv, ast.Attribute)
+                    and isinstance(recv.value, ast.Name)
+                    and recv.value.id == "self"))
+            if not plausible_thread:
+                return None
+            if isinstance(recv, (ast.Name, ast.Attribute)):
+                rname = recv.id if isinstance(recv, ast.Name) else recv.attr
+                if "path" in rname.lower() or "sep" in rname.lower():
+                    return None
+            if node.args and not self._is_numeric(node.args[0]):
+                return None
+            if not node.args and any(kw.arg != "timeout"
+                                     for kw in node.keywords):
+                return None
+            return ".join()"
+        return None
+
+    def _iter_body_calls(self, with_node: ast.With):
+        """Calls lexically under the with body, not descending into
+        nested defs (they run later, without the lock)."""
+        stack = list(with_node.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            if isinstance(node, ast.Call):
+                yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def check(self, ctx: ModuleContext) -> Iterable[Finding]:
+        for node in ast.walk(ctx.tree):
+            if not (isinstance(node, ast.With)
+                    and any(_lockish_with_item(i) for i in node.items)):
+                continue
+            guard = next(i for i in node.items
+                         if _lockish_with_item(i))
+            ge = guard.context_expr
+            gname = ge.attr if isinstance(ge, ast.Attribute) else ge.id
+            io_guard = any(m in gname.lower()
+                           for m in ("send", "write", "io"))
+            for call in self._iter_body_calls(node):
+                reason = self._blocking_reason(call, ctx)
+                if reason is None:
+                    continue
+                if io_guard and "socket io" in reason:
+                    # A lock named for the I/O it serializes (e.g. a
+                    # per-connection _send_lock around sendall) IS the
+                    # critical section — frame integrity demands it.
+                    continue
+                yield ctx.finding(
+                    self, call,
+                    f"blocking {reason} while holding '{gname}': every "
+                    f"thread contending for the lock convoys behind "
+                    f"this call; move it outside the critical section")
 
 
 @register

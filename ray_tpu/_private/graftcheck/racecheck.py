@@ -1,0 +1,386 @@
+"""Env-gated Eraser-style lockset data-race detector (GC300 plane).
+
+Armed by ``RAY_TPU_RACECHECK=1``. The runtime wraps its hot shared
+containers in ``traced_shared(obj, name)`` proxies; every read/write
+through a proxy records (thread, held traced-lock set from
+``runtime_trace``, read-or-write, call site) and advances the classic
+Eraser state machine per structure:
+
+    VIRGIN -> EXCLUSIVE (first access, single thread)
+           -> SHARED / SHARED_MODIFIED (second thread arrives)
+
+From the moment a second thread touches the structure, the candidate
+lockset ``C`` is refined by intersection with the locks held at each
+access. When ``C`` goes empty while the structure is write-shared, no
+single lock protects it and a finding is emitted:
+
+- **GC301** — the emptying access is a *write performed with no traced
+  locks held at all*: an outright unsynchronized write to shared state.
+- **GC302** — every access held *some* lock but no common one exists
+  (two sides use different locks, or a reader goes in bare): the
+  classic lockset-intersection-went-empty race.
+
+Findings flow through the same ``findings.Finding`` machinery as the
+static rules — baseline suppression by (rule, path, context) where
+context is the structure name, and inline ``# graftcheck: disable=``
+comments on the access line are honored via ``linecache``.
+
+With the knob unset ``traced_shared`` returns its argument unchanged —
+the raw dict/list/set/deque, zero added indirection in production.
+
+Granularity is per *structure* (the name passed to ``traced_shared``),
+not per key: the runtime's tables are guarded table-at-a-time, so a
+per-structure lockset matches the locking discipline being checked.
+Per-instance state is kept (two ``_Batcher`` instances don't share a
+state machine) but findings deduplicate on (rule, name, site).
+"""
+
+from __future__ import annotations
+
+import linecache
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from . import runtime_trace
+from .findings import (Finding, SEVERITY_ERROR, load_inline_suppressions,
+                       relpath)
+
+# Eraser states.
+_VIRGIN = 0
+_EXCLUSIVE = 1
+_SHARED = 2
+_SHARED_MOD = 3
+
+_STATE_NAMES = {_VIRGIN: "virgin", _EXCLUSIVE: "exclusive",
+                _SHARED: "shared", _SHARED_MOD: "shared-modified"}
+
+_reg_lock = threading.Lock()
+_findings: List[Finding] = []
+_seen: set = set()
+
+# Monotonic per-thread tokens instead of `threading.get_ident()`: the
+# OS recycles idents, so a short-lived writer's successor could alias
+# the EXCLUSIVE owner and silently re-seed the lockset — masking the
+# exact unsynchronized-write pattern the detector exists to catch.
+_tls = threading.local()
+_token_lock = threading.Lock()
+_token_next = 1
+
+
+def _thread_token() -> int:
+    tok = getattr(_tls, "token", None)
+    if tok is None:
+        global _token_next
+        with _token_lock:
+            tok = _tls.token = _token_next
+            _token_next += 1
+    return tok
+
+_ENABLED: Optional[bool] = None
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def enabled() -> bool:
+    """The env knob, read once per process (tests use reset_state()
+    after flipping it)."""
+    global _ENABLED
+    if _ENABLED is None:
+        from .. import config
+        _ENABLED = bool(config.get("RAY_TPU_RACECHECK"))
+    return _ENABLED
+
+
+def reset_state() -> None:
+    """Test helper: drop collected findings and re-read the env knob.
+    Proxies created while armed keep their shadow state but stop
+    recording if the knob is now off."""
+    global _ENABLED
+    _ENABLED = None
+    with _reg_lock:
+        _findings.clear()
+        _seen.clear()
+
+
+def get_findings() -> List[Finding]:
+    with _reg_lock:
+        return list(_findings)
+
+
+class ShadowState:
+    """Per-structure Eraser state: current state, first-owner thread,
+    candidate lockset, and the last access (for diagnostics)."""
+
+    __slots__ = ("name", "state", "owner", "lockset", "last_access")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.state = _VIRGIN
+        self.owner: Optional[int] = None
+        self.lockset: frozenset = frozenset()
+        # (thread name, is_write, held, path, line, qualname)
+        self.last_access: Optional[tuple] = None
+
+
+def _call_site() -> Tuple[str, int, str]:
+    """Walk out of graftcheck frames to the access site in user code."""
+    f = sys._getframe(1)
+    while f is not None:
+        fn = f.f_code.co_filename
+        if not os.path.abspath(fn).startswith(_PKG_DIR):
+            qual = getattr(f.f_code, "co_qualname", f.f_code.co_name)
+            return fn, f.f_lineno, qual
+        f = f.f_back
+    return "<unknown>", 0, ""
+
+
+def _inline_suppressed(path: str, line: int, rule: str) -> bool:
+    src = linecache.getline(path, line)
+    if "graftcheck" not in src:
+        return False
+    _file_rules, line_rules = load_inline_suppressions(src)
+    return rule in line_rules.get(1, set())
+
+
+def _report(st: ShadowState, is_write: bool, held: tuple,
+            site: Tuple[str, int, str]) -> None:
+    path, line, qual = site
+    rule = "GC301" if (is_write and not held) else "GC302"
+    dedup = (rule, st.name, path, line)
+    if dedup in _seen:
+        return
+    _seen.add(dedup)
+    tname = threading.current_thread().name
+    if rule == "GC301":
+        msg = (f"unsynchronized write to shared structure {st.name!r}: "
+               f"thread {tname!r} wrote with no locks held")
+    else:
+        kind = "write" if is_write else "read"
+        held_s = ", ".join(held) if held else "no locks"
+        msg = (f"no common lock protects shared structure {st.name!r}: "
+               f"candidate lockset went empty on a {kind} by thread "
+               f"{tname!r} holding {held_s}")
+    prev = st.last_access
+    if prev is not None:
+        ptname, pwrite, pheld, ppath, pline, pqual = prev
+        pheld_s = ", ".join(pheld) if pheld else "no locks"
+        msg += (f"; previous {'write' if pwrite else 'read'} by thread "
+                f"{ptname!r} holding {pheld_s} at "
+                f"{relpath(ppath)}:{pline}")
+    f = Finding(rule=rule, path=relpath(path), line=line,
+                severity=SEVERITY_ERROR, message=msg, context=st.name,
+                inline_suppressed=_inline_suppressed(path, line, rule))
+    _findings.append(f)
+
+
+def record_access(st: ShadowState, is_write: bool) -> None:
+    """Advance the Eraser state machine for one access."""
+    if not enabled():
+        return
+    tid = _thread_token()
+    held = runtime_trace.held_locks()
+    site = _call_site()
+    with _reg_lock:
+        if st.state == _VIRGIN:
+            st.state = _EXCLUSIVE
+            st.owner = tid
+            st.lockset = frozenset(held)
+        elif st.state == _EXCLUSIVE and tid == st.owner:
+            # Initialization pattern: a single thread may set up the
+            # structure lock-free; the candidate set is (re)seeded, not
+            # refined, until a second thread arrives.
+            st.lockset = frozenset(held)
+        else:
+            st.lockset = st.lockset & frozenset(held)
+            if st.state in (_VIRGIN, _EXCLUSIVE, _SHARED):
+                st.state = _SHARED_MOD if is_write else _SHARED
+            elif is_write:
+                st.state = _SHARED_MOD
+            if st.state == _SHARED_MOD and not st.lockset:
+                _report(st, is_write, held, site)
+        st.last_access = (threading.current_thread().name, is_write,
+                          held, site[0], site[1], site[2])
+
+
+# ---------------------------------------------------------------------------
+# Proxy wrappers
+
+
+def unwrap(obj):
+    """The underlying container of a proxy (identity for anything else)."""
+    return obj._rc_obj if isinstance(obj, _TracedProxy) else obj
+
+
+class _TracedProxy:
+    """Base: delegates everything not intercepted to the wrapped object."""
+
+    __slots__ = ("_rc_obj", "_rc_state")
+
+    # Method names that mutate, per delegated call.
+    _writes: frozenset = frozenset()
+    # Method names that only observe.
+    _reads: frozenset = frozenset()
+
+    def __init__(self, obj, state: ShadowState):
+        object.__setattr__(self, "_rc_obj", obj)
+        object.__setattr__(self, "_rc_state", state)
+
+    # -- generic protocol plumbing (each records read/write) --
+    def __len__(self):
+        record_access(self._rc_state, False)
+        return len(self._rc_obj)
+
+    def __iter__(self):
+        record_access(self._rc_state, False)
+        return iter(self._rc_obj)
+
+    def __contains__(self, item):
+        record_access(self._rc_state, False)
+        return item in self._rc_obj
+
+    def __getitem__(self, key):
+        record_access(self._rc_state, False)
+        return self._rc_obj[key]
+
+    def __setitem__(self, key, value):
+        record_access(self._rc_state, True)
+        self._rc_obj[key] = value
+
+    def __delitem__(self, key):
+        record_access(self._rc_state, True)
+        del self._rc_obj[key]
+
+    def __reversed__(self):
+        record_access(self._rc_state, False)
+        return reversed(self._rc_obj)
+
+    def __bool__(self):
+        record_access(self._rc_state, False)
+        return bool(self._rc_obj)
+
+    def __eq__(self, other):
+        record_access(self._rc_state, False)
+        return self._rc_obj == unwrap(other)
+
+    def __ne__(self, other):
+        record_access(self._rc_state, False)
+        return self._rc_obj != unwrap(other)
+
+    def __hash__(self):
+        return object.__hash__(self)
+
+    def __repr__(self):
+        return f"traced_shared({self._rc_obj!r})"
+
+    def __reduce__(self):
+        # Serialization strips the proxy: the wire carries the raw
+        # container, never detector state.
+        return (_rebuild, (self._rc_obj,))
+
+    def __getattr__(self, attr):
+        target = getattr(self._rc_obj, attr)
+        st = self._rc_state
+        if attr in type(self)._writes:
+            def _w(*a, **kw):
+                record_access(st, True)
+                return target(*a, **kw)
+            return _w
+        if attr in type(self)._reads:
+            def _r(*a, **kw):
+                record_access(st, False)
+                return target(*a, **kw)
+            return _r
+        return target
+
+
+def _rebuild(obj):
+    return obj
+
+
+class _DictProxy(_TracedProxy):
+    __slots__ = ()
+    _writes = frozenset({"clear", "pop", "popitem", "setdefault", "update",
+                         "move_to_end"})
+    _reads = frozenset({"get", "keys", "values", "items", "copy"})
+
+    def __or__(self, other):
+        record_access(self._rc_state, False)
+        return self._rc_obj | unwrap(other)
+
+    def __ior__(self, other):
+        record_access(self._rc_state, True)
+        self._rc_obj.update(unwrap(other))
+        return self
+
+
+class _ListProxy(_TracedProxy):
+    __slots__ = ()
+    _writes = frozenset({"append", "extend", "insert", "remove", "pop",
+                         "clear", "sort", "reverse", "appendleft",
+                         "extendleft", "popleft", "rotate"})
+    _reads = frozenset({"index", "count", "copy"})
+
+    def __iadd__(self, other):
+        record_access(self._rc_state, True)
+        self._rc_obj.extend(unwrap(other))
+        return self
+
+    def __add__(self, other):
+        record_access(self._rc_state, False)
+        return self._rc_obj + unwrap(other)
+
+
+class _SetProxy(_TracedProxy):
+    __slots__ = ()
+    _writes = frozenset({"add", "discard", "remove", "pop", "clear",
+                         "update", "difference_update",
+                         "intersection_update",
+                         "symmetric_difference_update"})
+    _reads = frozenset({"union", "difference", "intersection", "issubset",
+                        "issuperset", "isdisjoint", "copy",
+                        "symmetric_difference"})
+
+    def __ior__(self, other):
+        record_access(self._rc_state, True)
+        self._rc_obj.update(unwrap(other))
+        return self
+
+    def __isub__(self, other):
+        record_access(self._rc_state, True)
+        self._rc_obj.difference_update(unwrap(other))
+        return self
+
+    def __or__(self, other):
+        record_access(self._rc_state, False)
+        return self._rc_obj | unwrap(other)
+
+    def __sub__(self, other):
+        record_access(self._rc_state, False)
+        return self._rc_obj - unwrap(other)
+
+    def __and__(self, other):
+        record_access(self._rc_state, False)
+        return self._rc_obj & unwrap(other)
+
+
+def traced_shared(obj, name: str):
+    """Wrap a shared container in an access-recording proxy when the
+    racecheck knob is armed; return ``obj`` itself (same identity, zero
+    indirection) otherwise.
+
+    ``name`` is the structure's site name (e.g. ``"_RefTracker._counts"``)
+    — the stable ``context`` under which findings are baselined.
+    """
+    if not enabled():
+        return obj
+    import collections
+    st = ShadowState(name)
+    if isinstance(obj, (dict, collections.Counter)):
+        return _DictProxy(obj, st)
+    if isinstance(obj, (list, collections.deque)):
+        return _ListProxy(obj, st)
+    if isinstance(obj, (set, frozenset)):
+        return _SetProxy(obj, st)
+    return obj

@@ -35,11 +35,14 @@ _ENABLED: Optional[bool] = None
 
 def enabled() -> bool:
     """The env knob, read once per process (tests use reset_state()
-    after flipping it)."""
+    after flipping it). RAY_TPU_RACECHECK also arms the traced locks:
+    the lockset detector (racecheck.py) needs to know which traced
+    locks each thread holds at every shared-structure access."""
     global _ENABLED
     if _ENABLED is None:
         from .. import config
-        _ENABLED = bool(config.get("RAY_TPU_LOCKCHECK"))
+        _ENABLED = bool(config.get("RAY_TPU_LOCKCHECK")) or bool(
+            config.get("RAY_TPU_RACECHECK"))
     return _ENABLED
 
 
@@ -63,6 +66,13 @@ def _held_stack() -> List[str]:
     if stack is None:
         stack = _tls.stack = []
     return stack
+
+
+def held_locks() -> Tuple[str, ...]:
+    """Site names of every traced lock the calling thread currently
+    holds, innermost last. The lockset detector intersects these to
+    find the candidate lock protecting a shared structure."""
+    return tuple(_held_stack())
 
 
 def _note_acquire(name: str) -> None:

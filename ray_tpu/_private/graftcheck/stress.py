@@ -1,0 +1,264 @@
+"""Seeded deterministic interleaving stress harness (the TSAN-analog
+driver for the GC300 race plane).
+
+``InterleaveRunner(seed)`` spins up a live single-process runtime with
+racecheck armed, then runs N barrier-started threads through per-seed
+shuffled scripts of mixed ``put``/``get``/``del``/``borrow``/
+``actor-kill``/``evict`` ops. The thread *interleavings* are real (that
+is the point — concurrent access drives the lockset state machines
+through their shared states), but every recorded op outcome is a pure
+function of the seed:
+
+- each thread's script comes from ``random.Random(f"{seed}:{t}")``;
+- ops touch only the thread's OWN objects/actor plus a read-only
+  shared borrow pool created before the barrier drops;
+- recorded details are sizes/checksums/indices, never runtime ids.
+
+So the merged trace, sorted by (thread, seq), replays byte-identical
+from the seed — ``trace_bytes(run1) == trace_bytes(run2)`` — the same
+determinism gate ``chaos.py`` holds for fault injection.
+
+Before the stress ops run, the harness fires a **planted-race canary**
+(two sequenced threads, one unlocked dict write) and checks the
+detector reports GC301 for it: a run that would silently miss races
+fails loudly instead. Canary findings are filtered out of the reported
+set by their structure name.
+
+Surfaced as ``python -m ray_tpu.scripts check --race [--stress SEED]``.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import random
+import threading
+from typing import Dict, List, Optional
+
+from . import racecheck, runtime_trace
+
+CANARY_STRUCT = "stress.canary_table"
+
+_OPS = ("put", "get", "borrow", "evict", "actor_call", "actor_kill")
+
+
+def trace_bytes(entries: List[dict]) -> bytes:
+    """Canonical serialization for byte-identical replay comparison
+    (same idiom as chaos.trace_bytes)."""
+    return "\n".join(
+        json.dumps(e, sort_keys=True) for e in entries).encode()
+
+
+def _checksum(payload: bytes) -> str:
+    return hashlib.sha1(payload).hexdigest()[:12]
+
+
+def plant_canary() -> bool:
+    """Deterministic planted race: thread A writes a traced dict under
+    a traced lock, then thread B writes it bare. The lockset
+    intersection empties on B's unlocked write ⇒ GC301. Returns True
+    when the detector reported it (the arming sanity check)."""
+    lock = runtime_trace.make_lock("stress.canary_lock")
+    table = racecheck.traced_shared({}, CANARY_STRUCT)
+    a_done = threading.Event()
+
+    def writer_locked():
+        with lock:
+            table["k"] = 1
+        a_done.set()
+
+    def writer_bare():
+        a_done.wait(5.0)
+        table["k"] = 2
+
+    ta = threading.Thread(target=writer_locked, name="canary-locked")
+    tb = threading.Thread(target=writer_bare, name="canary-bare")
+    ta.start(); tb.start()
+    ta.join(5.0); tb.join(5.0)
+    return any(f.rule == "GC301" and f.context == CANARY_STRUCT
+               for f in racecheck.get_findings())
+
+
+class InterleaveRunner:
+    """Deterministic mixed-op interleaving stress against a live
+    runtime. Construct with a seed; ``run()`` arms racecheck, spins
+    the runtime, races the scripts, and returns::
+
+        {"seed": ..., "threads": ..., "ops_per_thread": ...,
+         "canary_ok": bool,        # planted GC301 was detected
+         "trace": [ {thread, seq, op, detail}, ... ],
+         "trace_bytes": b"...",    # canonical, seed-reproducible
+         "findings": [Finding...]} # GC30x findings, canary excluded
+
+    The caller must not already hold an initialized runtime.
+    """
+
+    def __init__(self, seed: int, threads: int = 3,
+                 ops_per_thread: int = 16, use_actors: bool = True):
+        self.seed = int(seed)
+        self.threads = int(threads)
+        self.ops_per_thread = int(ops_per_thread)
+        self.use_actors = use_actors
+
+    # -- script generation (pure function of the seed) --
+    def _script(self, t: int) -> List[dict]:
+        rng = random.Random(f"{self.seed}:{t}")
+        weights = {"put": 4, "get": 4, "borrow": 3, "evict": 2,
+                   "actor_call": 3 if self.use_actors else 0,
+                   "actor_kill": 1 if self.use_actors else 0}
+        ops = [op for op in _OPS if weights[op]]
+        script = []
+        for _ in range(self.ops_per_thread):
+            op = rng.choices(ops, weights=[weights[o] for o in ops])[0]
+            script.append({"op": op, "size": rng.randrange(8, 256),
+                           "pick": rng.random()})
+        return script
+
+    def run(self) -> dict:
+        import ray_tpu
+        from .. import config
+        from .. import metrics as metrics_mod
+        if ray_tpu.is_initialized():
+            raise RuntimeError(
+                "InterleaveRunner.run() needs to build its own runtime "
+                "with racecheck armed; call ray_tpu.shutdown() first")
+        config.set_override("RAY_TPU_RACECHECK", 1)
+        runtime_trace.reset_state()
+        racecheck.reset_state()
+        metrics_mod.reset()  # re-wraps the registry tables traced
+        try:
+            canary_ok = plant_canary()
+            trace = self._run_armed(ray_tpu)
+            findings = [f for f in racecheck.get_findings()
+                        if f.context != CANARY_STRUCT]
+        finally:
+            config.clear_override("RAY_TPU_RACECHECK")
+            runtime_trace.reset_state()
+            racecheck.reset_state()
+            metrics_mod.reset()  # back to raw tables
+        trace.sort(key=lambda e: (e["thread"], e["seq"]))
+        return {"seed": self.seed, "threads": self.threads,
+                "ops_per_thread": self.ops_per_thread,
+                "canary_ok": canary_ok, "trace": trace,
+                "trace_bytes": trace_bytes(trace),
+                "findings": findings}
+
+    def _run_armed(self, ray_tpu) -> List[dict]:
+        ray_tpu.init(num_cpus=max(2, self.threads))
+        try:
+            # Read-only borrow pool, created before the barrier drops so
+            # borrow outcomes are deterministic.
+            pool_payloads = [
+                random.Random(f"{self.seed}:pool:{i}").randbytes(64)
+                for i in range(4)]
+            pool = [ray_tpu.put(p) for p in pool_payloads]
+
+            actors = []
+            if self.use_actors:
+                @ray_tpu.remote
+                class _Pinger:  # noqa: N801 - local actor class
+                    def ping(self, x):
+                        return x
+
+                actors = [_Pinger.remote() for _ in range(self.threads)]
+                # Warm them up so creation cost is off the racing path.
+                ray_tpu.get([a.ping.remote(0) for a in actors])
+
+            barrier = threading.Barrier(self.threads)
+            traces: List[List[dict]] = [[] for _ in range(self.threads)]
+            errors: List[BaseException] = []
+
+            def worker(t: int):
+                rng = random.Random(f"{self.seed}:exec:{t}")
+                script = self._script(t)
+                own: List[tuple] = []   # (ref, checksum) still live
+                actor = actors[t] if self.use_actors else None
+                actor_dead = False
+                barrier.wait(timeout=30)
+                for seq, step in enumerate(script):
+                    op = step["op"]
+                    try:
+                        if op == "put":
+                            payload = random.Random(
+                                f"{self.seed}:{t}:{seq}").randbytes(
+                                    step["size"])
+                            ref = ray_tpu.put(payload)
+                            own.append((ref, _checksum(payload)))
+                            detail = {"size": step["size"],
+                                      "sum": _checksum(payload)}
+                        elif op == "get" and own:
+                            i = int(step["pick"] * len(own))
+                            ref, want = own[i]
+                            got = ray_tpu.get(ref, timeout=30)
+                            detail = {"i": i, "sum": _checksum(got),
+                                      "ok": _checksum(got) == want}
+                        elif op == "evict" and own:
+                            i = int(step["pick"] * len(own))
+                            ref, _ = own.pop(i)
+                            ray_tpu.free([ref])
+                            detail = {"i": i}
+                        elif op == "borrow":
+                            i = int(step["pick"] * len(pool))
+                            got = ray_tpu.get(pool[i], timeout=30)
+                            detail = {"i": i, "sum": _checksum(got),
+                                      "ok": got == pool_payloads[i]}
+                        elif op == "actor_call" and actor is not None:
+                            if actor_dead:
+                                detail = {"dead": True}
+                            else:
+                                n = int(step["pick"] * 1000)
+                                got = ray_tpu.get(
+                                    actor.ping.remote(n), timeout=30)
+                                detail = {"n": n, "ok": got == n}
+                        elif op == "actor_kill" and actor is not None:
+                            # Threads only kill their OWN actor, so the
+                            # dead/alive sequence is per-thread
+                            # deterministic.
+                            if not actor_dead:
+                                ray_tpu.kill(actor)
+                                actor_dead = True
+                                detail = {"killed": True}
+                            else:
+                                detail = {"killed": False}
+                        else:
+                            detail = {"skip": True}
+                    except Exception as e:  # noqa: BLE001 - trace it
+                        detail = {"error": type(e).__name__}
+                    traces[t].append({"thread": t, "seq": seq,
+                                      "op": op, "detail": detail})
+
+            threads = [threading.Thread(target=worker, args=(t,),
+                                        name=f"stress-{t}")
+                       for t in range(self.threads)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=120)
+                if th.is_alive():
+                    errors.append(TimeoutError(f"{th.name} wedged"))
+            if errors:
+                raise errors[0]
+            return [e for tr in traces for e in tr]
+        finally:
+            ray_tpu.shutdown()
+
+
+def run_stress(seed: Optional[int] = None, threads: int = 3,
+               ops_per_thread: int = 16, use_actors: bool = True) -> dict:
+    """One stress run at `seed` (default: RAY_TPU_RACE_STRESS_SEED)."""
+    if seed is None:
+        from .. import config
+        seed = config.get("RAY_TPU_RACE_STRESS_SEED")
+    return InterleaveRunner(seed, threads=threads,
+                            ops_per_thread=ops_per_thread,
+                            use_actors=use_actors).run()
+
+
+def verify_replay(seed: Optional[int] = None, **kw) -> dict:
+    """Run the harness twice at the same seed and compare canonical
+    traces — the byte-identity gate. Returns the first run's result
+    with ``"replay_identical"`` added."""
+    r1 = run_stress(seed, **kw)
+    r2 = run_stress(r1["seed"], **kw)
+    r1["replay_identical"] = r1["trace_bytes"] == r2["trace_bytes"]
+    return r1

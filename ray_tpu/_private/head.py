@@ -38,6 +38,8 @@ from ..exceptions import ActorDiedError, WorkerCrashedError
 from .ids import ActorID, TaskID
 from .task_spec import ACTOR_CREATION_TASK, TaskSpec
 from . import chaos, config, protocol, task_events
+from .graftcheck import racecheck
+from .graftcheck.runtime_trace import make_rlock
 
 logger = logging.getLogger(__name__)
 
@@ -147,17 +149,20 @@ class HeadServer:
         if ctl is not None and not ctl.once_dir:
             ctl.once_dir = session_dir
 
-        self._lock = threading.RLock()
+        self._lock = make_rlock("HeadServer._lock")
         self._kv: Dict[str, bytes] = {}
         self._subs: Dict[str, Set[protocol.Connection]] = {}
         self._nodes: Dict[str, NodeInfo] = {
             "node0": NodeInfo("node0", resources)}
         self._workers: Dict[str, WorkerInfo] = {}  # by addr once registered
         self._spawned: Dict[str, WorkerInfo] = {}  # by token
-        self._pending: deque = deque()  # TaskSpec queue
-        self._inflight: Dict[TaskID, str] = {}  # task -> worker addr
+        self._pending: deque = racecheck.traced_shared(
+            deque(), "HeadServer._pending")  # TaskSpec queue
+        self._inflight: Dict[TaskID, str] = racecheck.traced_shared(
+            {}, "HeadServer._inflight")  # task -> worker addr
         # Unserved lease demand: [caller_addr, resources, remaining].
-        self._lease_queue: List[list] = []
+        self._lease_queue: List[list] = racecheck.traced_shared(
+            [], "HeadServer._lease_queue")
         self._actors: Dict[ActorID, ActorInfo] = {}
         self._drivers: Set[protocol.Connection] = set()
         self._conns_by_addr: Dict[str, protocol.Connection] = {}
@@ -202,18 +207,23 @@ class HeadServer:
         # often each replica was handed out as a source, so resolution
         # can order least-loaded first. Bounded LRU.
         from collections import OrderedDict as _OD
-        self._obj_locations: "_OD[object, Dict[str, str]]" = _OD()
-        self._obj_location_grants: Dict[str, int] = {}
+        self._obj_locations: "_OD[object, Dict[str, str]]" = \
+            racecheck.traced_shared(_OD(), "HeadServer._obj_locations")
+        self._obj_location_grants: Dict[str, int] = \
+            racecheck.traced_shared(
+                {}, "HeadServer._obj_location_grants")
         self._obj_locations_max = 4096
         # Per-process metric snapshots pushed by workers/drivers
         # (addr -> {"node":, "counters":, "gauges":}).
-        self._metric_snaps: Dict[str, dict] = {}
+        self._metric_snaps: Dict[str, dict] = racecheck.traced_shared(
+            {}, "HeadServer._metric_snaps")
         # COUNTERS of processes that died or disconnected, folded per
         # node: a counter is a cluster-lifetime total, so a killed
         # worker's tasks_executed / chaos_injections_total must not
         # vanish with its connection (gauges are point-in-time and DO
         # die with the process).
-        self._dead_counters: Dict[str, Dict[str, float]] = {}
+        self._dead_counters: Dict[str, Dict[str, float]] = \
+            racecheck.traced_shared({}, "HeadServer._dead_counters")
         self._metrics_http = None
         # Rate ring: bounded trailing window of (ts, counter totals)
         # snapshots the monitor loop appends, so rates() can report
@@ -811,7 +821,7 @@ class HeadServer:
                 # served earlier demand: keep growing toward the deficit.
                 self._grow_pool_for_leases_locked(resources, remaining)
                 still.append(req)
-        self._lease_queue = still
+        self._lease_queue[:] = still
 
     def _h_cancel_lease_requests(self, conn, msg):
         """Caller's backlog drained before its queued lease demand was
@@ -828,7 +838,7 @@ class HeadServer:
                     count -= taken
                 if req[2] > 0:
                     kept.append(req)
-            self._lease_queue = kept
+            self._lease_queue[:] = kept
 
     def _h_return_lease(self, conn, msg):
         with self._lock:
@@ -859,8 +869,8 @@ class HeadServer:
                     w.leased_to = None
                     w.lease_resources = None
                     victims.append(w)
-            self._lease_queue = [r for r in self._lease_queue
-                                 if r[0] != caller]
+            self._lease_queue[:] = [r for r in self._lease_queue
+                                    if r[0] != caller]
             self._schedule_locked()
         for w in victims:
             if w.conn is not None:

@@ -96,15 +96,28 @@ from __future__ import annotations
 import contextlib
 import math
 import re
-import threading
 import time
 from typing import Dict, Optional
 
-_lock = threading.Lock()
-_counters: Dict[str, float] = {}
-_gauges: Dict[str, float] = {}
-_hists: Dict[str, dict] = {}
-_rollups: Dict[str, str] = {}
+from .graftcheck import racecheck
+from .graftcheck.runtime_trace import make_lock
+
+
+def _fresh_registry():
+    """Registry tables + their lock, built through the graftcheck
+    factories: plain dicts and a plain threading.Lock normally; under
+    RAY_TPU_RACECHECK/RAY_TPU_LOCKCHECK, access-recording proxies and a
+    traced lock (the metrics registry is one of the instrumented hot
+    shared structures — every process thread incs/observes into it
+    while the push loop snapshots)."""
+    return (make_lock("metrics._lock"),
+            racecheck.traced_shared({}, "metrics._counters"),
+            racecheck.traced_shared({}, "metrics._gauges"),
+            racecheck.traced_shared({}, "metrics._hists"),
+            racecheck.traced_shared({}, "metrics._rollups"))
+
+
+_lock, _counters, _gauges, _hists, _rollups = _fresh_registry()
 
 # Geometric bucket ratio for histograms. 2**0.25 bounds any quantile
 # estimate's relative error by HIST_FACTOR - 1 (~18.9%) while keeping
@@ -196,12 +209,12 @@ def snapshot() -> Dict[str, dict]:
 
 
 def reset() -> None:
-    """Test helper."""
-    with _lock:
-        _counters.clear()
-        _gauges.clear()
-        _hists.clear()
-        _rollups.clear()
+    """Test helper: drops the registry and rebuilds it through the
+    traced factories, re-reading the RACECHECK/LOCKCHECK knobs — so a
+    harness that arms the race plane mid-process (graftcheck/stress.py)
+    gets an instrumented registry, and disarming restores raw tables."""
+    global _lock, _counters, _gauges, _hists, _rollups
+    _lock, _counters, _gauges, _hists, _rollups = _fresh_registry()
 
 
 def merge_hist(dst: dict, src: dict) -> None:

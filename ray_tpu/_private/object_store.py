@@ -23,6 +23,7 @@ import threading
 from typing import Dict, Optional
 
 from . import serialization
+from .graftcheck import racecheck
 from .graftcheck.runtime_trace import make_condition, make_lock
 from .ids import ObjectID
 
@@ -47,7 +48,8 @@ class MemoryStore:
     """In-process store of deserialized object values with blocking get."""
 
     def __init__(self):
-        self._objects: Dict[ObjectID, object] = {}
+        self._objects: Dict[ObjectID, object] = \
+            racecheck.traced_shared({}, "MemoryStore._objects")
         self._lock = make_lock("MemoryStore._lock")
         self._cv = make_condition("MemoryStore._cv", self._lock)
 
@@ -170,7 +172,8 @@ class SharedObjectStore:
         self.session_name = session_name
         self.prefix = os.path.join(SHM_DIR, f"raytpu_{session_name}_")
         # Pins: mmaps we must keep open because deserialized values alias them.
-        self._pins: Dict[ObjectID, _Pin] = {}
+        self._pins: Dict[ObjectID, _Pin] = \
+            racecheck.traced_shared({}, "SharedObjectStore._pins")
         self._lock = make_lock("SharedObjectStore._lock")
         # Distribution-plane hooks (runtime.py): on_seal(oid) fires after
         # any blob lands sealed (local put, fetched copy, striped

@@ -39,6 +39,7 @@ from . import chaos, config
 from . import object_ref as object_ref_mod
 from . import protocol, serialization, task_events
 from .backoff import Backoff
+from .graftcheck import racecheck
 from .graftcheck.runtime_trace import (make_condition, make_lock,
                                        make_rlock)
 from .ids import ActorID, JobID, ObjectID, TaskID
@@ -85,7 +86,8 @@ class _SendTicket:
         self.raw_bytes = 0
         self._cv = make_condition("_SendTicket._cv")
         self._outstanding = 0
-        self.failed: list = []
+        self.failed: list = racecheck.traced_shared(
+            [], "_SendTicket.failed")
         self.exc: Optional[BaseException] = None
 
     def dispatching(self):
@@ -112,7 +114,8 @@ class _SendTicket:
         with self._cv:
             while self._outstanding:
                 self._cv.wait()
-            out, self.failed = self.failed, []
+            out = list(self.failed)
+            self.failed.clear()
             return out
 
 
@@ -222,7 +225,8 @@ class _TransferPool:
         self._rt = runtime
         self.addr = addr
         self._lock = make_lock("_TransferPool._lock")
-        self._workers: List[_StripeWorker] = []
+        self._workers: List[_StripeWorker] = \
+            racecheck.traced_shared([], "_TransferPool._workers")
         self._target = max(0, config.get("RAY_TPU_TRANSFER_STREAMS"))
         self._dial_fail_until = 0.0
         self._closed = False
@@ -240,7 +244,7 @@ class _TransferPool:
     # -- connections ---------------------------------------------------
     def _ensure_workers(self) -> List[_StripeWorker]:
         with self._lock:
-            self._workers = [w for w in self._workers if w.alive]
+            self._workers[:] = [w for w in self._workers if w.alive]
             if self._target < 2:
                 # Single-stream mode still funnels chunk sends through
                 # ONE dedicated sender thread (over the control
@@ -282,7 +286,8 @@ class _TransferPool:
     def close(self):
         with self._lock:
             self._closed = True
-            workers, self._workers = self._workers, []
+            workers = list(self._workers)
+            self._workers.clear()
         for w in workers:
             w.stop(join_timeout=1.0)
 
@@ -517,7 +522,8 @@ class _RefTracker:
     def __init__(self, runtime):
         import queue as _queue
         self._rt = runtime
-        self._counts: Dict[ObjectID, int] = {}
+        self._counts: Dict[ObjectID, int] = \
+            racecheck.traced_shared({}, "_RefTracker._counts")
         self._lock = make_rlock("_RefTracker._lock")
         self._notify_q: "_queue.SimpleQueue" = _queue.SimpleQueue()
         self._notify_thread = threading.Thread(
@@ -667,7 +673,8 @@ class _Batcher:
         self._on_fail = on_fail  # (addr, msgs, exc) after a failed send
         self._lock = make_lock("_Batcher._lock")
         self._cv = make_condition("_Batcher._cv", self._lock)
-        self._pending: deque = deque()
+        self._pending: deque = racecheck.traced_shared(
+            deque(), "_Batcher._pending")
         self._stopped = False
         self._thread = threading.Thread(
             target=self._loop, daemon=True, name="send-batcher")
@@ -1147,8 +1154,9 @@ class Runtime:
                 self._export_pins.setdefault(oid, []).append(
                     (peer_addr, deadline))
 
-    def _consume_export_pin(self, oid: ObjectID, from_addr: str):
-        """An ack_export releases the pin of one copy delivered to that
+    def _consume_export_pin_locked(self, oid: ObjectID,
+                                   from_addr: str):
+        """Caller holds _owned_lock. An ack_export releases the pin of one copy delivered to that
         exact peer. Exact match ONLY: a third party re-pickling a ref we
         own (task forwarding) also acks, and letting it pop an arbitrary
         pin would strip protection from a genuinely in-flight copy.
@@ -2719,7 +2727,8 @@ class Runtime:
             # (the sender's add_borrow, when any, was ordered before
             # this on the same connection, so the borrow is registered).
             with self._owned_lock:
-                self._consume_export_pin(msg["object_id"], conn.peer_addr)
+                self._consume_export_pin_locked(msg["object_id"],
+                                                conn.peer_addr)
         elif kind == "remove_borrow":
             with self._owned_lock:
                 per = self._borrows.get(msg["object_id"])

@@ -17,6 +17,9 @@ import time
 from collections import OrderedDict
 from typing import Dict, List, Optional
 
+from .graftcheck import racecheck
+from .graftcheck.runtime_trace import make_lock
+
 SUBMITTED = "SUBMITTED"
 QUEUED = "QUEUED"
 LEASED = "LEASED"
@@ -61,8 +64,9 @@ class TaskEventBuffer:
 
     def __init__(self, runtime):
         self._runtime = runtime
-        self._buf: List[dict] = []
-        self._lock = threading.Lock()
+        self._buf: List[dict] = racecheck.traced_shared(
+            [], "TaskEventBuffer._buf")
+        self._lock = make_lock("TaskEventBuffer._lock")
         self._stop = threading.Event()
         self._thread = threading.Thread(
             target=self._flush_loop, daemon=True, name="task-events-flush")
@@ -93,7 +97,10 @@ class TaskEventBuffer:
         with self._lock:
             if not self._buf:
                 return
-            batch, self._buf = self._buf, []
+            # Copy-and-clear (not rebind): the buffer object stays the
+            # one the racecheck proxy wraps.
+            batch = list(self._buf)
+            self._buf.clear()
         try:
             self._runtime.head.send(
                 {"kind": "task_events", "events": batch})
@@ -112,8 +119,9 @@ class TaskStateLog:
 
     def __init__(self, max_tasks: int = 4096):
         self._max = max(1, int(max_tasks))
-        self._records: "OrderedDict[str, dict]" = OrderedDict()
-        self._lock = threading.Lock()
+        self._records: "OrderedDict[str, dict]" = racecheck.traced_shared(
+            OrderedDict(), "TaskStateLog._records")
+        self._lock = make_lock("TaskStateLog._lock")
 
     def apply(self, ev: dict) -> None:
         tid = ev.get("task_id")
@@ -148,7 +156,9 @@ class TaskStateLog:
                 rec = {"task_id": tid, "name": "", "kind": "task",
                        "state": state, "node": None, "worker_pid": None,
                        "caller": None, "parent_task_id": None,
-                       "error": None, "events": []}
+                       "error": None,
+                       "events": racecheck.traced_shared(
+                           [], "TaskStateLog.record.events")}
                 self._records[tid] = rec
                 while len(self._records) > self._max:
                     self._records.popitem(last=False)
@@ -205,35 +215,41 @@ class TaskStateLog:
 
     def list(self, state: Optional[str] = None, name: Optional[str] = None,
              limit: int = 100) -> List[dict]:
-        """Newest-first record views, optionally filtered."""
-        with self._lock:
-            recs = list(self._records.values())
+        """Newest-first record views, optionally filtered.
+
+        Views are built UNDER the lock: a record's fields and its
+        events list keep mutating via apply() on other head connection
+        threads, so snapshotting only the record references and reading
+        them outside the critical section hands out torn views (state
+        already terminal, events still missing) — the first real race
+        the GC300 lockset detector surfaced (GC302 on
+        TaskStateLog.record.events)."""
         out = []
-        for rec in reversed(recs):
-            if state is not None and rec["state"] != state:
-                continue
-            if name is not None and rec["name"] != name:
-                continue
-            out.append(self._view(rec))
-            if limit and len(out) >= limit:
-                break
+        with self._lock:
+            for rec in reversed(list(self._records.values())):
+                if state is not None and rec["state"] != state:
+                    continue
+                if name is not None and rec["name"] != name:
+                    continue
+                out.append(self._view(rec))
+                if limit and len(out) >= limit:
+                    break
         return out
 
     def summary(self) -> Dict[str, Dict[str, int]]:
         """Per-state counts grouped by function/method name (parity:
-        `ray summary tasks`)."""
-        with self._lock:
-            recs = list(self._records.values())
+        `ray summary tasks`). Counted under the lock — see list()."""
         out: Dict[str, Dict[str, int]] = {}
-        for rec in recs:
-            per = out.setdefault(rec["name"] or rec["task_id"][:12], {})
-            per[rec["state"]] = per.get(rec["state"], 0) + 1
+        with self._lock:
+            for rec in self._records.values():
+                per = out.setdefault(
+                    rec["name"] or rec["task_id"][:12], {})
+                per[rec["state"]] = per.get(rec["state"], 0) + 1
         return out
 
     def state_counts(self) -> Dict[str, int]:
-        with self._lock:
-            recs = list(self._records.values())
         out: Dict[str, int] = {}
-        for rec in recs:
-            out[rec["state"]] = out.get(rec["state"], 0) + 1
+        with self._lock:
+            for rec in self._records.values():
+                out[rec["state"]] = out.get(rec["state"], 0) + 1
         return out

@@ -635,13 +635,17 @@ def cmd_chaos(args):
 def cmd_check(args):
     """Framework-aware static analysis (graftcheck): lint rules for
     distributed anti-patterns + static lock-order cycle detection.
-    Exits non-zero on findings not covered by the suppression
-    baseline. See README "Correctness tooling"."""
+    `--race` adds the GC300 lockset data-race plane (seeded
+    interleaving stress against a live runtime); `--stress SEED` pins
+    the seed and verifies byte-identical replay. Exits non-zero on
+    findings not covered by the suppression baseline. See README
+    "Correctness tooling"."""
     from ray_tpu._private.graftcheck import cli as graftcheck_cli
     sys.exit(graftcheck_cli.run(
         args.paths, baseline_path=args.baseline,
         write_baseline=args.write_baseline, as_json=args.json,
-        lockgraph=not args.no_lockgraph))
+        lockgraph=not args.no_lockgraph, race=args.race,
+        stress_seed=args.stress))
 
 
 def main(argv=None):
@@ -655,6 +659,12 @@ def main(argv=None):
     p.add_argument("--write-baseline", action="store_true")
     p.add_argument("--json", action="store_true")
     p.add_argument("--no-lockgraph", action="store_true")
+    p.add_argument("--race", action="store_true",
+                   help="also run the lockset race plane (GC301/GC302) "
+                        "via the interleaving stress harness")
+    p.add_argument("--stress", type=int, default=None, metavar="SEED",
+                   help="race-stress seed (implies --race); verifies "
+                        "byte-identical replay")
     p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser(

@@ -1,0 +1,29 @@
+"""Seeded GC109: blocking calls made while holding a lock — every
+thread contending for the lock convoys behind the sleep/join/recv."""
+
+import time
+import threading
+
+
+class BlockingUnderLock:
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._sock = sock
+        self._worker = threading.Thread(target=self._drain)
+        self._frames = []
+
+    def _drain(self):
+        pass
+
+    def throttle(self):
+        with self._lock:
+            time.sleep(0.5)  # BAD: sleeping inside the critical section
+
+    def stop(self):
+        with self._lock:
+            self._worker.join(2.0)  # BAD: join while holding the lock
+
+    def pump(self):
+        with self._lock:
+            data = self._sock.recv(4096)  # BAD: socket io under the lock
+        return data

@@ -1,0 +1,25 @@
+"""Seeded GC108: `self._table` and `self._count` are mutated under
+`self._lock` on the hot path but written bare on other paths — the
+bare writes race every locked access."""
+
+import threading
+
+
+class MixedDiscipline:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._table = {}
+        self._count = 0
+
+    def record(self, key, value):
+        with self._lock:
+            self._table[key] = value
+            self._count += 1
+
+    def forget(self, key):
+        # BAD: same table, no lock.
+        self._table.pop(key, None)
+
+    def reset_count(self):
+        # BAD: counter written bare while record() increments it locked.
+        self._count = 0

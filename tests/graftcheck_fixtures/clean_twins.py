@@ -63,6 +63,38 @@ def cleanup_loop(conns):
             pass
 
 
+class CleanLockDiscipline:
+    """GC108/GC109 twins: every mutation takes the lock (or sits in a
+    `*_locked` helper, the called-with-lock-held convention); joins and
+    sleeps happen outside the critical section; str/path joins and
+    sends under a dedicated send lock are not blocking-call findings."""
+
+    def __init__(self, sock):
+        self._lock = threading.Lock()
+        self._send_lock = threading.Lock()
+        self._sock = sock
+        self._table = {}
+        self._names = ["a", "b"]
+
+    def record(self, key, value):
+        with self._lock:
+            self._table[key] = value
+            self._evict_locked()
+
+    def _evict_locked(self):
+        # Caller holds _lock: writes here are locked by convention.
+        if len(self._table) > 64:
+            self._table.clear()
+
+    def label(self):
+        with self._lock:
+            return ",".join(self._names)  # str.join: not a thread join
+
+    def send(self, frame):
+        with self._send_lock:
+            self._sock.sendall(frame)  # the lock exists to serialize io
+
+
 class CleanService:
     def __init__(self):
         self._stop = threading.Event()

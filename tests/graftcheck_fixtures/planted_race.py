@@ -1,0 +1,40 @@
+"""Planted data race for the GC300 lockset detector (runtime fixture,
+not a static-lint seed): two sequenced threads share a traced dict —
+thread A writes it under a traced lock, thread B writes it bare. The
+candidate lockset empties on B's unlocked write, so GC301 must fire on
+every run, deterministically (the threads are explicitly ordered by an
+Event; no interleaving luck involved).
+
+`run_planted_race()` assumes the caller has already armed
+``RAY_TPU_RACECHECK`` and reset detector state; it returns the GC30x
+findings attributed to this fixture's structure.
+"""
+
+import threading
+
+from ray_tpu._private.graftcheck import racecheck, runtime_trace
+
+STRUCT = "planted_race.shared_table"
+
+
+def run_planted_race():
+    lock = runtime_trace.make_lock("planted_race.lock")
+    table = racecheck.traced_shared({}, STRUCT)
+    locked_done = threading.Event()
+
+    def locked_writer():
+        with lock:
+            table["slot"] = "locked"
+        locked_done.set()
+
+    def bare_writer():
+        locked_done.wait(5.0)
+        table["slot"] = "bare"  # the race: no lock held
+
+    a = threading.Thread(target=locked_writer, name="planted-locked")
+    b = threading.Thread(target=bare_writer, name="planted-bare")
+    a.start()
+    b.start()
+    a.join(5.0)
+    b.join(5.0)
+    return [f for f in racecheck.get_findings() if f.context == STRUCT]

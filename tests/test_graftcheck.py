@@ -39,6 +39,8 @@ def _lint_rules(path):
     ("bad_swallowed_exception.py", "GC105", 2),
     ("bad_daemon_thread.py", "GC106", 2),
     ("bad_unbounded_retry.py", "GC107", 2),
+    ("bad_mixed_lock.py", "GC108", 2),
+    ("bad_blocking_under_lock.py", "GC109", 3),
 ])
 def test_rule_fires(fixture, rule, count):
     findings = run_lint([_fixture(fixture)])

@@ -113,7 +113,7 @@ class TestExportPins:
         # The (delayed) acknowledgement arrives; borrow registered.
         with rt._owned_lock:
             rt._borrows.setdefault(oid, {})["fake-peer-addr"] = 1
-            rt._consume_export_pin(oid, "fake-peer-addr")
+            rt._consume_export_pin_locked(oid, "fake-peer-addr")
         assert oid not in rt._export_pins
         # Borrow released -> object becomes evictable again.
         with rt._owned_lock:

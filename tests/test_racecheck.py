@@ -1,0 +1,436 @@
+"""GC300 race plane: Eraser lockset state machine, traced-proxy
+read/write attribution, the planted-race fixture, the regression pair
+for the real race the detector surfaced (TaskStateLog torn views), and
+the tier-1 deterministic stress-harness gates (seed replay byte-identity
+and the fixed-seed smoke against the checked-in baseline).
+"""
+
+import os
+import pickle
+import random
+import sys
+import threading
+from collections import Counter, OrderedDict, deque
+
+import pytest
+
+from ray_tpu._private.graftcheck import racecheck, runtime_trace, stress
+from ray_tpu._private.graftcheck.findings import Baseline
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "graftcheck_fixtures")
+sys.path.insert(0, FIXTURES)
+
+
+def _reset_all():
+    runtime_trace.reset_state()
+    racecheck.reset_state()
+
+
+@pytest.fixture
+def racecheck_env(monkeypatch):
+    monkeypatch.setenv("RAY_TPU_RACECHECK", "1")
+    _reset_all()
+    yield
+    monkeypatch.delenv("RAY_TPU_RACECHECK", raising=False)
+    _reset_all()
+
+
+def _sequenced(*steps):
+    """Run each step on its own thread, strictly ordered by Events — a
+    deterministic interleaving (no scheduling luck involved)."""
+    gates = [threading.Event() for _ in steps]
+
+    def runner(i, fn):
+        if i:
+            gates[i - 1].wait(5.0)
+        try:
+            fn()
+        finally:
+            gates[i].set()
+
+    threads = [threading.Thread(target=runner, args=(i, fn),
+                                name=f"seq-{i}")
+               for i, fn in enumerate(steps)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(5.0)
+    assert not any(t.is_alive() for t in threads)
+
+
+# ---------------------------------------------------------------------
+# zero-overhead guarantee and the lockset state machine
+# ---------------------------------------------------------------------
+def test_disabled_traced_shared_is_identity(monkeypatch):
+    """Overhead guard: with the knob off, traced_shared returns its
+    argument unchanged — same identity, zero indirection."""
+    monkeypatch.delenv("RAY_TPU_RACECHECK", raising=False)
+    _reset_all()
+    for obj in ({}, [], set(), deque(), Counter(), OrderedDict()):
+        assert racecheck.traced_shared(obj, "fixture.off") is obj
+
+
+def test_enabled_wraps_by_container_kind(racecheck_env):
+    d = racecheck.traced_shared({}, "fixture.d")
+    l = racecheck.traced_shared([], "fixture.l")
+    s = racecheck.traced_shared(set(), "fixture.s")
+    assert type(d).__name__ == "_DictProxy"
+    assert type(l).__name__ == "_ListProxy"
+    assert type(s).__name__ == "_SetProxy"
+    assert racecheck.unwrap(d) == {}
+
+
+def test_single_thread_init_pattern_clean(racecheck_env):
+    """One thread may build up a structure lock-free (EXCLUSIVE): the
+    candidate set is re-seeded per access, never reported."""
+    d = racecheck.traced_shared({}, "fixture.init")
+    for i in range(5):
+        d[i] = i  # bare writes, single thread
+    lock = runtime_trace.make_lock("fixture.init_lock")
+    with lock:
+        d["late"] = 1
+    st = d._rc_state
+    assert st.state == 1  # EXCLUSIVE
+    assert st.lockset == frozenset({"fixture.init_lock"})  # re-seeded
+    assert racecheck.get_findings() == []
+
+
+def test_second_thread_common_lock_clean(racecheck_env):
+    lock = runtime_trace.make_lock("fixture.common")
+    d = racecheck.traced_shared({}, "fixture.shared_ok")
+
+    def a():
+        with lock:
+            d["a"] = 1
+
+    def b():
+        with lock:
+            d["b"] = 2
+
+    _sequenced(a, b)
+    st = d._rc_state
+    assert st.state == 3  # SHARED_MODIFIED, but the lockset holds
+    assert st.lockset == frozenset({"fixture.common"})
+    assert racecheck.get_findings() == []
+
+
+def test_read_only_sharing_never_reports(racecheck_env):
+    """A second thread reading bare moves the state to SHARED with an
+    empty candidate set — reads alone are not a race."""
+    d = racecheck.traced_shared({"k": 1}, "fixture.read_shared")
+
+    def a():
+        d["k"] = 2  # owner write, bare (init pattern)
+
+    def b():
+        assert d["k"] == 2  # bare read from a second thread
+
+    _sequenced(a, b)
+    st = d._rc_state
+    assert st.state == 2  # SHARED, not SHARED_MODIFIED
+    assert racecheck.get_findings() == []
+
+
+def test_gc302_different_locks(racecheck_env):
+    """Both sides lock, but not the same lock: the classic
+    lockset-intersection-went-empty race."""
+    l1 = runtime_trace.make_lock("fixture.lockA")
+    l2 = runtime_trace.make_lock("fixture.lockB")
+    d = racecheck.traced_shared({}, "fixture.two_locks")
+
+    def a():
+        with l1:
+            d["a"] = 1
+
+    def b():
+        with l2:
+            d["b"] = 2
+
+    _sequenced(a, b)
+    findings = racecheck.get_findings()
+    assert [f.rule for f in findings] == ["GC302"], \
+        [f.render() for f in findings]
+    f = findings[0]
+    assert f.context == "fixture.two_locks"
+    assert "no common lock" in f.message
+    assert "fixture.lockB" in f.message
+
+
+def test_finding_dedup_and_baseline_roundtrip(racecheck_env, tmp_path):
+    """The same (rule, structure, site) reports once, and a baselined
+    GC30x finding is matched on a later run (the tier-1 gate contract).
+    """
+    l1 = runtime_trace.make_lock("fixture.dedupA")
+    l2 = runtime_trace.make_lock("fixture.dedupB")
+    d = racecheck.traced_shared({}, "fixture.dedup")
+
+    def a():
+        with l1:
+            for _ in range(3):
+                d["a"] = 1
+
+    def b():
+        with l2:
+            for _ in range(3):
+                d["b"] = 2  # same site three times -> one finding
+
+    _sequenced(a, b)
+    findings = racecheck.get_findings()
+    assert len(findings) == 1, [f.render() for f in findings]
+    bl_path = tmp_path / "baseline.json"
+    Baseline.write(str(bl_path), findings)
+    bl = Baseline.load(str(bl_path))
+    assert all(bl.matches(f) for f in findings)
+
+
+# ---------------------------------------------------------------------
+# planted-race fixture: GC301 on every run, deterministically
+# ---------------------------------------------------------------------
+@pytest.mark.parametrize("attempt", [0, 1, 2])
+def test_planted_race_fixture_fires_gc301(racecheck_env, attempt):
+    import planted_race
+    _reset_all()
+    findings = planted_race.run_planted_race()
+    assert [f.rule for f in findings] == ["GC301"], \
+        [f.render() for f in findings]
+    f = findings[0]
+    assert f.context == planted_race.STRUCT
+    assert f.severity == "error"
+    assert "no locks held" in f.message
+
+
+# ---------------------------------------------------------------------
+# proxy read/write attribution
+# ---------------------------------------------------------------------
+def _last_is_write(proxy):
+    return proxy._rc_state.last_access[1]
+
+
+def test_proxy_attribution_dict(racecheck_env):
+    d = racecheck.traced_shared({}, "fixture.attr_d")
+    d["k"] = 1
+    assert _last_is_write(d) is True
+    assert d.get("k") == 1
+    assert _last_is_write(d) is False
+    d.update(x=2)
+    assert _last_is_write(d) is True
+    assert "k" in d
+    assert _last_is_write(d) is False
+    d.pop("x")
+    assert _last_is_write(d) is True
+    assert len(d) == 1
+    assert _last_is_write(d) is False
+
+
+def test_proxy_attribution_list_and_set(racecheck_env):
+    l = racecheck.traced_shared([], "fixture.attr_l")
+    l.append(1)
+    assert _last_is_write(l) is True
+    assert l.index(1) == 0
+    assert _last_is_write(l) is False
+    l += [2, 3]
+    assert _last_is_write(l) is True
+    assert list(iter(l)) == [1, 2, 3]
+    assert _last_is_write(l) is False
+
+    s = racecheck.traced_shared(set(), "fixture.attr_s")
+    s.add("x")
+    assert _last_is_write(s) is True
+    assert s.union({"y"}) == {"x", "y"}
+    assert _last_is_write(s) is False
+    s.discard("x")
+    assert _last_is_write(s) is True
+
+
+def test_proxy_deque_ops(racecheck_env):
+    q = racecheck.traced_shared(deque(), "fixture.attr_q")
+    q.append(1)
+    q.appendleft(0)
+    assert q.popleft() == 0
+    assert _last_is_write(q) is True
+    assert len(q) == 1
+
+
+def test_proxy_pickle_strips_detector_state(racecheck_env):
+    """Serialization carries the raw container, never the proxy — refs
+    crossing the wire must not leak shadow state into workers."""
+    d = racecheck.traced_shared({"k": 1}, "fixture.pickled")
+    out = pickle.loads(pickle.dumps(d))
+    assert type(out) is dict and out == {"k": 1}
+    l = racecheck.traced_shared([1, 2], "fixture.pickled_l")
+    assert pickle.loads(pickle.dumps(l)) == [1, 2]
+
+
+# ---------------------------------------------------------------------
+# regression pair: the real race the detector surfaced (torn views in
+# TaskStateLog.list) — the pre-fix shape still flags, the fixed code
+# stays clean under the same interleaving.
+# ---------------------------------------------------------------------
+class _UnfixedRing:
+    """The pre-fix TaskStateLog.list() shape: apply() mutates records
+    under the lock, list() snapshots only the record *references* under
+    the lock and reads their events outside it — torn views."""
+
+    def __init__(self):
+        self._records = {}
+        self._lock = runtime_trace.make_lock("_UnfixedRing._lock")
+
+    def apply(self, tid, state, ts):
+        with self._lock:
+            rec = self._records.setdefault(
+                tid, {"events": racecheck.traced_shared(
+                    [], "_UnfixedRing.record.events")})
+            rec["events"].append((state, ts))
+
+    def list(self):
+        with self._lock:
+            recs = list(self._records.values())
+        # BUG (pre-fix): events read outside the critical section.
+        return [sorted(r["events"], key=lambda e: e[1]) for r in recs]
+
+
+def test_unfixed_list_pattern_flagged(racecheck_env):
+    """Re-run the triggering interleaving: locked write -> bare read
+    from the reader thread -> locked write again. The bare read empties
+    the candidate set, so the next write is GC302."""
+    ring = _UnfixedRing()
+    views = []
+    _sequenced(
+        lambda: ring.apply("t1", "RUNNING", 1.0),
+        lambda: views.append(ring.list()),
+        lambda: ring.apply("t1", "FINISHED", 2.0),
+    )
+    findings = [f for f in racecheck.get_findings()
+                if f.context == "_UnfixedRing.record.events"]
+    assert [f.rule for f in findings] == ["GC302"], \
+        [f.render() for f in racecheck.get_findings()]
+    assert "no common lock" in findings[0].message
+
+
+def test_task_state_log_fixed_clean(racecheck_env):
+    """The fixed TaskStateLog builds views under the lock: the same
+    Event-ordered interleaving plus a seeded concurrent apply/list mix
+    produce zero findings on its structures."""
+    from ray_tpu._private.task_events import TaskStateLog
+    log = TaskStateLog(max_tasks=64)
+    views = []
+    _sequenced(
+        lambda: log.apply({"task_id": "t1", "state": "RUNNING",
+                           "ts": 1.0}),
+        lambda: views.append(log.list()),
+        lambda: log.apply({"task_id": "t1", "state": "FINISHED",
+                           "ts": 2.0}),
+    )
+    assert views[0][0]["task_id"] == "t1"
+
+    # Seeded concurrent mix: two appliers and a reader race for real.
+    barrier = threading.Barrier(3)
+
+    def applier(t):
+        rng = random.Random(f"99:{t}")
+        barrier.wait(timeout=10)
+        for i in range(50):
+            log.apply({"task_id": f"t{t}-{i % 7}",
+                       "state": rng.choice(("RUNNING", "FINISHED")),
+                       "ts": float(i)})
+
+    def reader():
+        barrier.wait(timeout=10)
+        for _ in range(50):
+            log.list()
+            log.summary()
+            log.state_counts()
+
+    threads = [threading.Thread(target=applier, args=(0,)),
+               threading.Thread(target=applier, args=(1,)),
+               threading.Thread(target=reader)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(10)
+    bad = [f for f in racecheck.get_findings()
+           if f.context.startswith("TaskStateLog")]
+    assert bad == [], [f.render() for f in bad]
+
+
+# ---------------------------------------------------------------------
+# stress harness: determinism and the tier-1 smoke gate
+# ---------------------------------------------------------------------
+def test_stress_scripts_are_pure_seed_functions():
+    r = stress.InterleaveRunner(42, threads=2, ops_per_thread=8)
+    assert r._script(0) == stress.InterleaveRunner(
+        42, threads=2, ops_per_thread=8)._script(0)
+    assert r._script(0) != r._script(1)
+    assert stress.InterleaveRunner(43)._script(0) != r._script(0)
+
+
+def test_stress_refuses_live_runtime(ray_start):
+    with pytest.raises(RuntimeError, match="own runtime"):
+        stress.InterleaveRunner(1, threads=2).run()
+
+
+def test_stress_seed_replay_byte_identical():
+    """Acceptance: the harness's merged trace replays byte-identical
+    from the seed, and the planted canary proves the detector was live.
+    """
+    r = stress.verify_replay(seed=777, threads=2, ops_per_thread=6,
+                             use_actors=False)
+    assert r["canary_ok"], "planted-race canary did not fire"
+    assert r["replay_identical"], "stress trace diverged across replays"
+    assert len(r["trace"]) == 2 * 6
+    assert stress.trace_bytes(r["trace"]) == r["trace_bytes"]
+    assert r["findings"] == [], [f.render() for f in r["findings"]]
+
+
+def test_stress_smoke_gate_respects_baseline():
+    """Tier-1 gate: a short fixed-seed stress run over the live runtime
+    (head tables, transfer pool, ref tracker, object store all armed)
+    must produce no GC30x findings beyond `.graftcheck-baseline.json` —
+    the self-clean guarantee, enforced at the default seed."""
+    r = stress.run_stress(threads=2, ops_per_thread=8)
+    assert r["seed"] == 1234  # RAY_TPU_RACE_STRESS_SEED default
+    assert r["canary_ok"], "detector was not live during the smoke run"
+    bl = Baseline.load(os.path.join(REPO, ".graftcheck-baseline.json"))
+    new = [f for f in r["findings"]
+           if not f.inline_suppressed and not bl.matches(f)]
+    assert new == [], "new race findings:\n" + "\n".join(
+        f.render() for f in new)
+
+
+def test_two_node_run_self_clean():
+    """Zero-finding gate over `_private/` with racecheck armed under a
+    2-node cluster run: the head (in the driver process) schedules
+    across both nodes while every instrumented table is traced."""
+    import ray_tpu
+    from ray_tpu._private import config
+    from ray_tpu._private import metrics as metrics_mod
+    from ray_tpu.cluster_utils import Cluster
+
+    config.set_override("RAY_TPU_RACECHECK", 1)
+    _reset_all()
+    metrics_mod.reset()
+    cluster = None
+    try:
+        cluster = Cluster(head_resources={"CPU": 1})
+        cluster.add_node(resources={"CPU": 2})
+
+        @ray_tpu.remote
+        def square(x):
+            return x * x
+
+        refs = [square.options(num_cpus=1).remote(i) for i in range(8)]
+        assert ray_tpu.get(refs, timeout=60) == [i * i for i in range(8)]
+        ref = ray_tpu.put(b"x" * 1024)
+        assert ray_tpu.get(ref, timeout=30) == b"x" * 1024
+        ray_tpu.free([ref])
+        findings = racecheck.get_findings()
+    finally:
+        if cluster is not None:
+            cluster.shutdown()
+        config.clear_override("RAY_TPU_RACECHECK")
+        _reset_all()
+        metrics_mod.reset()
+    assert findings == [], "races in _private/ under 2-node run:\n" \
+        + "\n".join(f.render() for f in findings)
