@@ -298,7 +298,8 @@ def bench_anakin(n_dev: int, flops_per_step: float = 0.0):
 # r09+ trajectory lines: median vs p99 is the straggler story).
 TAIL_HISTS = ("get_wall_s", "put_wall_s", "task_exec_s",
               "task_queue_wait_s", "weight_sync_encode_s",
-              "weight_sync_apply_s", "wire_chunk_send_s")
+              "weight_sync_apply_s", "wire_chunk_send_s",
+              "actor_recovery_s")
 
 
 def snapshot_cluster_metrics():
@@ -324,6 +325,18 @@ def snapshot_cluster_metrics():
                "gauges": {k: round(v, 6)
                           for k, v in sorted(agg["gauges"].items())},
                "latency_tails": tails}
+        # Elastic-fleet block (fleet.py): only present when a
+        # FleetController saw churn during the run, so static benches
+        # stay byte-compatible.
+        if agg["counters"].get("fleet_joins_total") or \
+                agg["counters"].get("fleet_evictions_total"):
+            out["fleet"] = {
+                "fleet_size": agg["gauges"].get("fleet_size"),
+                "joins_total": agg["counters"].get(
+                    "fleet_joins_total", 0.0),
+                "evictions_total": agg["counters"].get(
+                    "fleet_evictions_total", 0.0),
+                "actor_recovery_s": tails.get("actor_recovery_s")}
         # Device-memory watermark (profiling plane): the aggregated
         # hbm_* gauges carry the cluster view; this block re-reads the
         # local devices at snapshot time so BENCH json records the

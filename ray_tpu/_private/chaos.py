@@ -40,6 +40,13 @@ Spec grammar (semicolon-separated clauses)::
                           (claimed atomically via a marker file — a
                           respawned worker must not re-kill itself on
                           its own k-th occurrence forever)
+              | window:<start>:<period>
+                          windowed schedule: fire on occurrence
+                          <start>, then every <period> occurrences
+                          after it (start, start+period, ...) — the
+                          rolling-preemption shape: a warmup, then a
+                          steady cadence of faults marching through
+                          the fleet
     param   :=  free-form per kind (e.g. delay seconds; default 0.05)
 
 Example::
@@ -108,6 +115,13 @@ SITES: Dict[str, Dict[str, str]] = {
     "agent.heartbeat": {
         "suppress": "node agent skips sending its heartbeat",
     },
+    "agent.preempt": {
+        "kill": "preempt the sampler actor named by the occurrence "
+                "detail: the fleet controller kills it and spawns a "
+                "replacement that rejoins through the versioned weight "
+                "plane (pair with window:<start>:<period> for a "
+                "rolling-preemption schedule)",
+    },
     "head.heartbeat": {
         "drop": "head ignores an arriving heartbeat (one-way partition)",
     },
@@ -123,15 +137,17 @@ class ChaosSpecError(ValueError):
 
 
 class _Rule:
-    __slots__ = ("site", "kind", "trigger", "value", "param", "target",
-                 "spec", "_rng", "_once_name")
+    __slots__ = ("site", "kind", "trigger", "value", "period", "param",
+                 "target", "spec", "_rng", "_once_name")
 
     def __init__(self, site: str, kind: str, trigger: str, value: float,
-                 param: Optional[str], seed: int, spec: str):
+                 param: Optional[str], seed: int, spec: str,
+                 period: float = 0.0):
         self.site = site
         self.kind = kind
-        self.trigger = trigger  # 'n' | 'every' | 'p' | 'once'
+        self.trigger = trigger  # 'n' | 'every' | 'p' | 'once' | 'window'
         self.value = value
+        self.period = period    # window trigger only
         self.param = param
         # '<target>@<value>' params scope the rule to occurrences whose
         # detail equals the target (e.g. actor.sample:delay:every1:a1@.2
@@ -153,6 +169,9 @@ class _Rule:
             return occ == int(self.value)
         if self.trigger == "every":
             return int(self.value) > 0 and occ % int(self.value) == 0
+        if self.trigger == "window":
+            start, period = int(self.value), int(self.period)
+            return occ >= start and (occ - start) % max(1, period) == 0
         # 'p': one draw per occurrence keeps the stream deterministic.
         return self._rng.random() < self.value
 
@@ -202,14 +221,18 @@ def parse_spec(spec: str, once_dir: Optional[str] = None):
                 raise ChaosSpecError(f"bad seed clause {clause!r}")
             continue
         parts = clause.split(":")
-        if len(parts) not in (3, 4):
+        # The window trigger spells its schedule with colons
+        # (site:kind:window:<start>:<period>[:param]), so it owns the
+        # 5/6-part shapes; everything else keeps the 3/4-part grammar.
+        is_window = len(parts) >= 3 and parts[2] == "window"
+        if len(parts) not in ((5, 6) if is_window else (3, 4)):
             raise ChaosSpecError(
                 f"bad chaos clause {clause!r}: want "
-                f"site:kind:trigger[:param]")
+                f"site:kind:trigger[:param] (window trigger: "
+                f"site:kind:window:<start>:<period>[:param])")
         raw_rules.append(parts)
     for parts in raw_rules:
         site, kind, trig = parts[0], parts[1], parts[2]
-        param = parts[3] if len(parts) == 4 else None
         if site not in SITES:
             raise ChaosSpecError(
                 f"unknown chaos site {site!r}; known: {sorted(SITES)}")
@@ -217,6 +240,22 @@ def parse_spec(spec: str, once_dir: Optional[str] = None):
             raise ChaosSpecError(
                 f"unknown fault kind {kind!r} for site {site!r}; "
                 f"known: {sorted(SITES[site])}")
+        if trig == "window":
+            param = parts[5] if len(parts) == 6 else None
+            try:
+                start, period = int(parts[3]), int(parts[4])
+            except ValueError:
+                raise ChaosSpecError(
+                    f"bad window trigger in {':'.join(parts)!r}: want "
+                    f"window:<start>:<period> with integer fields")
+            if start < 1 or period < 1:
+                raise ChaosSpecError(
+                    f"window start/period must be >= 1 in "
+                    f"{':'.join(parts)!r}")
+            rules.append(_Rule(site, kind, "window", start, param, seed,
+                               ":".join(parts), period=period))
+            continue
+        param = parts[3] if len(parts) == 4 else None
         for name in ("once", "every", "n", "p"):
             if trig.startswith(name):
                 try:
@@ -226,8 +265,8 @@ def parse_spec(spec: str, once_dir: Optional[str] = None):
                 break
         else:
             raise ChaosSpecError(
-                f"bad trigger {trig!r}: want n<k>, every<k>, p<float> "
-                f"or once<k>")
+                f"bad trigger {trig!r}: want n<k>, every<k>, p<float>, "
+                f"once<k> or window:<start>:<period>")
         if name == "p" and not 0.0 <= value <= 1.0:
             raise ChaosSpecError(f"probability out of range in {trig!r}")
         rules.append(_Rule(site, kind, name, value, param, seed,

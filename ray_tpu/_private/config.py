@@ -195,6 +195,30 @@ _def("RAY_TPU_STRAGGLER_PROFILE", bool, False,
      "the straggler detector flags; folded stacks land in "
      "<session>/logs/ and the trainer result's stragglers.profiles")
 
+# --- elastic fleet (fleet controller; _private/fleet.py) --------------
+_def("RAY_TPU_STRAGGLER_EVICT", bool, False,
+     "Turn straggler flags into remediation: an actor the detector "
+     "flags is evicted and replaced by the fleet controller (per-tag "
+     "throttled via RAY_TPU_FLEET_EVICT_INTERVAL_S and capped per "
+     "window via RAY_TPU_FLEET_EVICTIONS_PER_WINDOW). Off = flags stay "
+     "annotations")
+_def("RAY_TPU_FLEET_MIN", int, 1,
+     "Floor on the remote sampler fleet size: shrinks and straggler "
+     "evictions without a replacement never go below it")
+_def("RAY_TPU_FLEET_MAX", int, 64,
+     "Ceiling on the remote sampler fleet size: grows/joins never "
+     "exceed it")
+_def("RAY_TPU_FLEET_EVICT_INTERVAL_S", float, 30.0,
+     "Per-tag eviction throttle: the same actor tag is evicted at most "
+     "once per this many seconds (mirrors the straggler-profile "
+     "capture throttle)")
+_def("RAY_TPU_FLEET_EVICT_WINDOW_S", float, 60.0,
+     "Width of the fleet-wide eviction budget window")
+_def("RAY_TPU_FLEET_EVICTIONS_PER_WINDOW", int, 2,
+     "Max straggler evictions inside one RAY_TPU_FLEET_EVICT_WINDOW_S "
+     "window: a fleet-wide slowdown (learner stall, shared-host "
+     "contention) must not evict every sampler at once")
+
 # --- actors -----------------------------------------------------------
 _def("RAY_TPU_NUM_ACTOR_CHECKPOINTS_TO_KEEP", int, 20,
      "Checkpoint ids retained per Checkpointable actor")

@@ -1,0 +1,246 @@
+"""Elastic-fleet controller: grow, shrink, evict, and replace sampler
+actors mid-run.
+
+PR 4's chaos plane proved the stack recovers from a single actor death;
+this module turns that fault *tolerance* into fault *elasticity*
+(Podracer pods carved into independently-failing slices — PAPERS
+"Podracer architectures for scalable Reinforcement Learning"). The
+controller owns policy only — bounds, throttles, the membership ledger,
+and the recovery clock; the mechanics of spawning/retiring a worker
+(WorkerSet actor lifecycle, TaskPool draining, WeightBroadcaster
+registration) stay with the optimizer, injected as two callables:
+
+- ``spawn() -> (worker, tag)``: create a remote sampler, register it
+  with the weight plane (warm rejoins get a delta via
+  ``WeightBroadcaster.bootstrap``, cold joins a full blob), and prime
+  its in-flight sample tasks.
+- ``retire(worker) -> tag``: drain the worker's in-flight tasks from
+  the TaskPool, prune its weight-sync version entry, drop its ledgers,
+  and kill the actor.
+
+Every membership change lands in three places: the metrics plane
+(``fleet_size`` gauge, ``fleet_joins_total`` / ``fleet_evictions_total``
+counters, ``actor_recovery_s`` histogram from death/evict to the first
+post-rejoin sample), a bounded in-process event ledger, and — best
+effort — the head KV (``fleet:events``) so ``scripts fleet`` can render
+per-actor join/evict history without touching the trainer process.
+
+Straggler remediation (``RAY_TPU_STRAGGLER_EVICT=1``) routes through
+:meth:`FleetController.evict`, which is throttled per tag
+(``RAY_TPU_FLEET_EVICT_INTERVAL_S``) and capped per window
+(``RAY_TPU_FLEET_EVICTIONS_PER_WINDOW`` per
+``RAY_TPU_FLEET_EVICT_WINDOW_S``) — a fleet-wide slowdown must not
+evict every sampler at once. Chaos preemptions
+(``agent.preempt:kill``) route through :meth:`preempt`, which is
+deliberately NOT throttled: it models external capacity loss, and
+recovery from it must never be rate-limited.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+logger = logging.getLogger(__name__)
+
+# Bounded event ledger: enough for `scripts fleet` history without
+# growing the driver (or the KV value) with the run.
+MAX_EVENTS = 200
+
+FLEET_EVENTS_KV_KEY = "fleet:events"
+
+
+class EvictionThrottle:
+    """Per-tag min-interval + fleet-wide per-window eviction budget
+    (the TriggeredCapture throttle shape, plus a global cap)."""
+
+    def __init__(self, min_interval_s: Optional[float] = None,
+                 window_s: Optional[float] = None,
+                 max_per_window: Optional[int] = None):
+        from . import config
+        self.min_interval_s = (
+            config.get("RAY_TPU_FLEET_EVICT_INTERVAL_S")
+            if min_interval_s is None else min_interval_s)
+        self.window_s = (config.get("RAY_TPU_FLEET_EVICT_WINDOW_S")
+                         if window_s is None else window_s)
+        self.max_per_window = (
+            config.get("RAY_TPU_FLEET_EVICTIONS_PER_WINDOW")
+            if max_per_window is None else max_per_window)
+        self._last_by_tag: Dict[str, float] = {}
+        self._window_times: List[float] = []
+
+    def allow(self, tag: str, now: Optional[float] = None) -> bool:
+        """True iff an eviction of `tag` is inside budget right now;
+        records the eviction when allowed."""
+        if now is None:
+            now = time.monotonic()
+        last = self._last_by_tag.get(tag)
+        if last is not None and now - last < self.min_interval_s:
+            return False
+        self._window_times = [t for t in self._window_times
+                              if now - t < self.window_s]
+        if len(self._window_times) >= self.max_per_window:
+            return False
+        self._last_by_tag[tag] = now
+        self._window_times.append(now)
+        return True
+
+
+class FleetController:
+    """Membership policy for one optimizer's remote sampler fleet."""
+
+    def __init__(self, spawn: Callable, retire: Callable,
+                 size: Callable[[], int],
+                 min_size: Optional[int] = None,
+                 max_size: Optional[int] = None,
+                 throttle: Optional[EvictionThrottle] = None):
+        from . import config
+        self._spawn = spawn
+        self._retire = retire
+        self._size = size
+        self.min_size = (config.get("RAY_TPU_FLEET_MIN")
+                         if min_size is None else min_size)
+        self.max_size = (config.get("RAY_TPU_FLEET_MAX")
+                         if max_size is None else max_size)
+        self.throttle = throttle or EvictionThrottle()
+        self._lock = threading.Lock()
+        self.events: List[dict] = []
+        # Replacement tag -> (evict/death monotonic t0, wall ts): the
+        # recovery clock runs from the predecessor's death to the
+        # replacement's first harvested sample.
+        self._recovery_pending: Dict[str, float] = {}
+        self._recovery_s: List[float] = []
+        self.joins_total = 0
+        self.evictions_total = 0
+        self.throttled_evictions = 0
+
+    # -- membership ops -------------------------------------------------
+    @property
+    def size(self) -> int:
+        return int(self._size())
+
+    def grow(self, n: int = 1, reason: str = "grow") -> List[str]:
+        """Add up to `n` workers, bounded by RAY_TPU_FLEET_MAX."""
+        tags = []
+        for _ in range(max(0, int(n))):
+            if self.size >= self.max_size:
+                logger.info("fleet: at max_size=%d, not growing",
+                            self.max_size)
+                break
+            _, tag = self._join(reason)
+            tags.append(tag)
+        self.publish()
+        return tags
+
+    def shrink(self, n: int = 1, reason: str = "shrink") -> List[str]:
+        """Retire up to `n` workers (newest first via the optimizer's
+        retire order), bounded below by RAY_TPU_FLEET_MIN."""
+        tags = []
+        for _ in range(max(0, int(n))):
+            if self.size <= self.min_size:
+                logger.info("fleet: at min_size=%d, not shrinking",
+                            self.min_size)
+                break
+            tag = self._retire(None)  # None = optimizer picks (newest)
+            if tag is None:
+                break
+            self._record("remove", tag, reason=reason)
+            tags.append(tag)
+        self.publish()
+        return tags
+
+    def evict(self, worker, tag: str,
+              reason: str = "straggler") -> Optional[str]:
+        """Throttled evict-and-replace (straggler remediation). Returns
+        the replacement's tag, or None when the throttle held it."""
+        if not self.throttle.allow(tag):
+            self.throttled_evictions += 1
+            logger.info("fleet: eviction of %s throttled", tag)
+            return None
+        return self._evict(worker, tag, reason)
+
+    def preempt(self, worker, tag: str) -> Optional[str]:
+        """Unthrottled kill-and-replace (chaos agent.preempt / external
+        capacity loss): recovery is never rate-limited."""
+        return self._evict(worker, tag, "preempt")
+
+    def _evict(self, worker, tag: str, reason: str) -> Optional[str]:
+        from . import metrics
+        t0 = time.monotonic()
+        retired = self._retire(worker)
+        if retired is None:
+            return None  # already gone (double eviction race)
+        self.evictions_total += 1
+        metrics.inc("fleet_evictions_total")
+        self._record("evict", retired, reason=reason)
+        _, new_tag = self._join(f"replace:{retired}", t0=t0)
+        self.publish()
+        return new_tag
+
+    def _join(self, reason: str, t0: Optional[float] = None):
+        from . import metrics
+        worker, tag = self._spawn()
+        self.joins_total += 1
+        metrics.inc("fleet_joins_total")
+        self._record("join", tag, reason=reason)
+        if t0 is not None:
+            with self._lock:
+                self._recovery_pending[tag] = t0
+        return worker, tag
+
+    def note_sample(self, tag: str) -> None:
+        """First post-rejoin sample from a replacement closes its
+        recovery clock (called from the optimizer's pull loop; a dict
+        miss is the steady-state cost)."""
+        with self._lock:
+            t0 = self._recovery_pending.pop(tag, None)
+        if t0 is None:
+            return
+        from . import metrics
+        dt = time.monotonic() - t0
+        metrics.observe("actor_recovery_s", dt)
+        self._recovery_s.append(dt)
+        self._record("recovered", tag, recovery_s=round(dt, 4))
+        self.publish()
+
+    # -- ledger / reporting ---------------------------------------------
+    def _record(self, event: str, tag: str, **extra) -> None:
+        entry = {"ts": time.time(), "event": event, "tag": tag}
+        entry.update(extra)
+        with self._lock:
+            self.events.append(entry)
+            del self.events[:-MAX_EVENTS]
+
+    def stats(self) -> dict:
+        rec = sorted(self._recovery_s)
+        out = {
+            "fleet_size": self.size,
+            "fleet_min": self.min_size,
+            "fleet_max": self.max_size,
+            "joins_total": self.joins_total,
+            "evictions_total": self.evictions_total,
+            "throttled_evictions": self.throttled_evictions,
+            "recoveries": len(rec),
+        }
+        if rec:
+            out["recovery_s_p50"] = rec[len(rec) // 2]
+            out["recovery_s_max"] = rec[-1]
+        return out
+
+    def publish(self) -> None:
+        """Push the live view into the metrics plane (the fleet_size
+        gauge rolls up as a sum across publishers) and the event ledger
+        into the head KV for `scripts fleet`. Best effort: a controller
+        outliving its runtime must not throw from bookkeeping."""
+        from . import metrics
+        metrics.set_gauge("fleet_size", float(self.size))
+        try:
+            from ray_tpu.experimental import internal_kv
+            with self._lock:
+                blob = json.dumps(self.events)
+            internal_kv.kv_put(FLEET_EVENTS_KV_KEY, blob, overwrite=True)
+        except Exception:  # noqa: BLE001 — no runtime / head gone
+            pass

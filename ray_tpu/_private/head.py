@@ -25,6 +25,7 @@ time and echoed in the worker's hello (avoids pid races across nodes).
 
 from __future__ import annotations
 
+import json
 import logging
 import os
 import subprocess
@@ -513,9 +514,16 @@ class HeadServer:
 
     def rates(self, window_s: Optional[float] = None) -> Dict[str, float]:
         """Per-second rate of every cluster counter over the trailing
-        window (newest ring slot vs the oldest slot still inside the
-        window). Counters fold monotonically — dead-process totals move
-        into _dead_counters, never shrink — so deltas are >= 0."""
+        window. Counters fold monotonically — dead-process totals move
+        into _dead_counters, never shrink — so deltas are >= 0.
+
+        Each counter is baselined at the oldest in-window slot that
+        already CARRIES it, not at the window edge: a process's first
+        metrics push lands its whole lifetime total in one ring slot,
+        and measuring from a slot before that push would read the join
+        as a window-long phantom rate spike (a driver reattaching with
+        tasks_submitted=N told the autoscaler the backlog was growing
+        by N for a full window — suppressing idle scale-down)."""
         if window_s is None:
             window_s = config.get("RAY_TPU_RATE_WINDOW_S")
         with self._lock:
@@ -523,19 +531,19 @@ class HeadServer:
         if len(ring) < 2:
             return {}
         now_ts, now_counters = ring[-1]
-        base_ts, base_counters = ring[0]
-        for ts, counters in ring[:-1]:
-            if now_ts - ts <= window_s:
-                base_ts, base_counters = ts, counters
-                break
-        dt = now_ts - base_ts
-        if dt <= 0:
-            return {}
+        window = [(ts, counters) for ts, counters in ring[:-1]
+                  if now_ts - ts <= window_s]
+        if not window:
+            window = [ring[-2]]
         out = {}
         for k, v in now_counters.items():
-            delta = v - base_counters.get(k, 0.0)
-            if delta > 0:
-                out[k] = delta / dt
+            for ts, counters in window:
+                if k in counters:
+                    dt = now_ts - ts
+                    delta = v - counters[k]
+                    if dt > 0 and delta > 0:
+                        out[k] = delta / dt
+                    break
         return out
 
     # -- flight recorder (postmortem bundle; scripts dump) ---------------
@@ -570,6 +578,25 @@ class HeadServer:
             "node_mem_frac_gauge": agg["gauges"].get("node_mem_frac"),
             "head_stacks": profiling_mod.sample_once(),
         }
+        # Elastic-fleet postmortem: what the membership looked like and
+        # how churn recovered (gauge/counters roll up from publishers;
+        # the event ledger is whatever the FleetController last pushed
+        # into the KV).
+        fleet_sec = {
+            "fleet_size": agg["gauges"].get("fleet_size"),
+            "joins_total": agg["counters"].get("fleet_joins_total"),
+            "evictions_total": agg["counters"].get(
+                "fleet_evictions_total"),
+            "recovery_s": (agg.get("quantiles") or {}).get(
+                "actor_recovery_s"),
+        }
+        with self._lock:
+            raw_events = self._kv.get("ikv:fleet:events")
+        if raw_events:
+            try:
+                fleet_sec["events"] = json.loads(raw_events)
+            except (TypeError, ValueError):
+                pass
         return {
             "ts": time.time(),
             "session_dir": self.session_dir,
@@ -581,6 +608,7 @@ class HeadServer:
             "workers_registered": workers,
             "recent_errors": errors,
             "profiling": profiling_sec,
+            "fleet": fleet_sec,
         }
 
     def _h_debug_dump(self, conn, msg):

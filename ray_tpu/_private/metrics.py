@@ -89,6 +89,15 @@ a collective fused into the jitted update cannot be timed from the
 host); histogram `learner_allreduce_s.<codec>` (the same probe sample,
 codec-labeled, one observation per update). Snapshotted into bench.py
 kernel and MULTICHIP blocks as `allreduce_bytes_per_update`.
+
+Fleet-plane series (_private/fleet.py FleetController): gauge
+`fleet_size` (live remote-sampler count; default sum roll-up so
+several optimizers' fleets read as one cluster total), counters
+`fleet_joins_total` / `fleet_evictions_total` (every membership
+change, including chaos preemptions), and histogram `actor_recovery_s`
+(evict/death to the replacement's first harvested sample — the
+recovery-latency distribution `scripts fleet`, `scripts stat
+--metrics`, debug_dump and the bench snapshot report).
 """
 
 from __future__ import annotations

@@ -253,6 +253,35 @@ class WeightSyncEncoder:
                 nbytes=_tree_nbytes(tree))]
         return self._full_cache
 
+    def get_state(self) -> dict:
+        """Checkpointable encoder state: the version counter, the
+        receiver-view base, and the error-feedback residual. Restoring
+        it into a fresh encoder RESUMES the versioned broadcast stream
+        — receivers that tracked the old learner keep applying deltas
+        instead of being forced through a full resync."""
+        return {
+            "codec": self.codec,
+            "shard_count": self.shard_count,
+            "version": self.version,
+            "base": None if self._base is None else self._base.copy(),
+            "residual": (None if self._residual is None
+                         else self._residual.copy()),
+            "template": self._template,
+        }
+
+    def set_state(self, state: dict) -> None:
+        self.codec = state["codec"]
+        self.shard_count = int(state["shard_count"])
+        self.version = int(state["version"])
+        base = state.get("base")
+        self._base = None if base is None else np.asarray(
+            base, np.float32).copy()
+        residual = state.get("residual")
+        self._residual = None if residual is None else np.asarray(
+            residual, np.float32).copy()
+        self._template = state.get("template")
+        self._full_cache = None
+
     def _note_metrics(self, payloads, dt: float) -> None:
         from . import metrics
         total = sum(p.nbytes for p in payloads)

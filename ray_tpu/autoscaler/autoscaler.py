@@ -44,6 +44,11 @@ DEFAULT_CONFIG = {
     "max_workers": 4,
     "idle_timeout_s": 60.0,
     "max_launch_batch": 2,
+    # Seconds of live backlog GROWTH (cluster_rates queue derivative)
+    # to provision ahead of: growth of 3 vectors/s with a 10 s horizon
+    # adds 30 projected demand vectors on top of the snapshot. 0
+    # disables rate-driven scale-up.
+    "demand_horizon_s": 10.0,
     # name -> {"resources": {...}, "max_workers": int} — when empty the
     # provider's single default type serves all demand (legacy shape).
     "worker_types": {},
@@ -60,6 +65,8 @@ CLUSTER_CONFIG_SCHEMA = {
     "max_workers": (int, "global node cap"),
     "idle_timeout_s": ((int, float), "idle seconds before retiring"),
     "max_launch_batch": (int, "max launches per autoscaler tick"),
+    "demand_horizon_s": ((int, float), "seconds of live backlog growth "
+                                       "to provision ahead of"),
     "update_interval_s": ((int, float), "autoscaler poll period"),
     "ssh": (dict, "remote provider: hosts/command templates "
                   "(see node_provider.CommandNodeProvider)"),
@@ -168,11 +175,31 @@ class StandardAutoscaler:
         nodes = self.provider.non_terminated_nodes()
         self.load_metrics.prune_inactive(set(nodes))
 
+        # Live queue derivative off the head's rate ring (0.0 when the
+        # rate plane isn't feeding us — pure-snapshot behavior).
+        growth = self.load_metrics.backlog_growth_per_s()
+
         # -- scale down idle nodes (before counting capacity) ----------
         min_w = int(self.config["min_workers"])
         idle_timeout = float(self.config["idle_timeout_s"])
         removable = []
-        for nid in nodes:
+        if growth > 0:
+            # The backlog is growing: a node idle RIGHT NOW is about to
+            # be needed — terminating it here just forces a relaunch a
+            # few ticks later (terminate/launch churn under load).
+            nodes_idle = [
+                nid for nid in nodes
+                if nid in self.load_metrics.static_resources_by_node
+                and self.load_metrics.idle_seconds(nid)
+                > idle_timeout]
+            if nodes_idle:
+                logger.info(
+                    "autoscaler: backlog growing at %.1f/s — keeping "
+                    "%d idle node(s)", growth, len(nodes_idle))
+            nodes_for_removal = []
+        else:
+            nodes_for_removal = nodes
+        for nid in nodes_for_removal:
             if nid not in self.load_metrics.static_resources_by_node:
                 continue  # not registered yet: not idle, just young
             static = self.load_metrics.static_resources_by_node[nid]
@@ -233,14 +260,34 @@ class StandardAutoscaler:
         demand_vectors = self.load_metrics.pending_demand
         if demand_vectors is None:
             # Legacy scalar demand: homogeneous growth (no shape info).
-            if self.load_metrics.queued_demand > 0 and len(nodes) < max_w:
+            # A growing backlog counts as demand even when the snapshot
+            # queue momentarily reads 0 (submit burst between polls).
+            if (self.load_metrics.queued_demand > 0 or growth > 0) \
+                    and len(nodes) < max_w:
                 need = min(batch, max_w - len(nodes))
                 logger.info(
                     "autoscaler: launching %d node(s) "
-                    "(have %d, queued_demand %d)",
-                    need, len(nodes), self.load_metrics.queued_demand)
+                    "(have %d, queued_demand %d, growth %.1f/s)",
+                    need, len(nodes), self.load_metrics.queued_demand,
+                    growth)
                 self._launch(need, None)
             return
+
+        # Provision AHEAD of the queue: project the live backlog growth
+        # over demand_horizon_s and append that many demand vectors to
+        # the snapshot before bin-packing. Projected vectors borrow the
+        # shape of the observed pending work (its first vector) so they
+        # pack onto the same worker type; {"CPU": 1} when the snapshot
+        # is empty. Capped at 400 like the head's snapshot sample.
+        horizon = float(self.config["demand_horizon_s"])
+        if growth > 0 and horizon > 0:
+            projected = min(int(growth * horizon),
+                            max(0, 400 - len(demand_vectors)))
+            if projected > 0:
+                shape = dict(demand_vectors[0]) if demand_vectors \
+                    else {"CPU": 1.0}
+                demand_vectors = list(demand_vectors) + \
+                    [shape] * projected
         if not demand_vectors:
             return
 

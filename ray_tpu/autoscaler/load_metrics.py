@@ -26,6 +26,26 @@ class LoadMetrics:
         # see head.cluster_load). None = shape unknown (legacy feeders),
         # [] = no demand, [{...}, ...] = per-item vectors.
         self.pending_demand = None
+        # Live cluster_rates() view (trailing-window per-second counter
+        # rates off the head's rate ring). {} = no rate plane (legacy
+        # feeders / ring not warm yet) — rate-driven decisions degrade
+        # to the static-snapshot behavior.
+        self.counter_rates: Dict[str, float] = {}
+        self.last_rates_time = 0.0
+
+    def update_rates(self, rates: Dict[str, float]) -> None:
+        self.counter_rates = dict(rates or {})
+        self.last_rates_time = time.time()
+
+    def backlog_growth_per_s(self) -> float:
+        """Live queue-depth derivative: tasks entering the cluster
+        minus tasks leaving it over the rate ring's trailing window.
+        Positive = the backlog is growing faster than the fleet drains
+        it (scale up ahead of the queue); negative/zero = the snapshot
+        demand is already draining."""
+        r = self.counter_rates
+        return float(r.get("tasks_submitted", 0.0)
+                     - r.get("tasks_executed", 0.0))
 
     def update(self, node_id: str, static: dict, dynamic: dict) -> None:
         now = time.time()

@@ -42,6 +42,16 @@ class AutoscalerMonitor:
         return self._head_conn.request(
             {"kind": "cluster_load"}, timeout=10.0)["load"]
 
+    def _rates(self) -> dict:
+        """Live counter rates off the head's rate ring ({} when the
+        ring isn't warm yet — the autoscaler then falls back to pure
+        snapshot demand)."""
+        if self._head is not None:
+            return self._head.rates()
+        agg = self._head_conn.request(
+            {"kind": "get_metrics"}, timeout=10.0)["metrics"]
+        return agg.get("rates") or {}
+
     def poll_once(self) -> None:
         snap = self._snapshot()
         # The head node itself is not autoscaler-managed; worker nodes
@@ -56,6 +66,11 @@ class AutoscalerMonitor:
             snap["pending_tasks"] + snap["lease_queue_depth"])
         if "pending_demand" in snap:
             self.load_metrics.pending_demand = snap["pending_demand"]
+        try:
+            self.load_metrics.update_rates(self._rates())
+        except Exception:
+            logger.debug("rates fetch failed (head still warming?)",
+                         exc_info=True)
         self.autoscaler.update()
 
     def _run(self):

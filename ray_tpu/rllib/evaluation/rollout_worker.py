@@ -299,6 +299,14 @@ class RolloutWorker:
                    if self._weight_decoder is not None else 0)
         return {"status": "ok", "version": version}
 
+    def weight_sync_version(self) -> int:
+        """The sync version this worker's decoder holds (0 = no base).
+        The fleet controller's join path asks for it so a warm rejoin
+        can be routed a delta instead of the full blob
+        (`WeightBroadcaster.bootstrap`)."""
+        return (self._weight_decoder.version
+                if self._weight_decoder is not None else 0)
+
     # -- filters (parity: FilterManager.synchronize) ---------------------
     def get_filters(self, flush_after: bool = False):
         f = self.obs_filter.as_serializable()

@@ -46,6 +46,11 @@ class WorkerSet:
             pack_fragments=config.get("pack_fragments", False))
         self.remote_workers: List = []
         self._broadcaster = None  # weight-sync delta plane (lazy)
+        self._remote_cls = None
+        # Monotonic worker index: fleet joins/replacements always get a
+        # FRESH index (never reuse a dead worker's), so per-actor
+        # ledgers and recovery histories stay attributable.
+        self._next_index = num_workers + 1
         if num_workers > 0:
             self._remote_cls = ray_tpu.remote(RolloutWorker)
             for i in range(num_workers):
@@ -117,6 +122,32 @@ class WorkerSet:
             get_ref=lambda w: w.get_filters.remote(flush_after=True),
             sync_call=lambda w, f: w.sync_filters.remote(f))
 
+    def add_worker(self):
+        """Grow the fleet by one remote worker at a fresh index (fleet
+        controller join path). Blocks until the actor is constructed."""
+        if self._remote_cls is None:
+            self._remote_cls = ray_tpu.remote(RolloutWorker)
+        w = self._make_remote_worker(self._next_index)
+        self._next_index += 1
+        ray_tpu.get(w.ping.remote())
+        self.remote_workers.append(w)
+        return w
+
+    def remove_worker(self, worker):
+        """Retire one remote worker: drop it from the set, prune its
+        weight-sync version entry, and kill the actor (fleet controller
+        shrink/evict path)."""
+        try:
+            self.remote_workers.remove(worker)
+        except ValueError:
+            pass
+        if self._broadcaster is not None:
+            self._broadcaster.remove_worker(worker)
+        try:
+            ray_tpu.kill(worker)
+        except Exception:
+            pass
+
     def recreate_failed_worker(self, worker):
         """Replace a dead remote worker (reference: `ignore_worker_failures`
         path in `trainer.py:425`)."""
@@ -126,7 +157,9 @@ class WorkerSet:
         self.remote_workers[idx] = new
         if self._broadcaster is not None:
             # The replacement holds no delta base: next sync full-blobs.
-            self._broadcaster.forget(worker)
+            # Full removal (not just forget) also drops the dead
+            # handle's pending acks.
+            self._broadcaster.remove_worker(worker)
         return new
 
     def stop(self):

@@ -379,17 +379,103 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
             for a in self._inline_actors:
                 a.start()
 
+        # Elastic fleet (fleet.py): membership policy over the remote
+        # sampler fleet — grow/shrink/evict/preempt mid-run, straggler
+        # remediation (RAY_TPU_STRAGGLER_EVICT), and the
+        # actor_recovery_s clock from death/evict to first post-rejoin
+        # sample.
+        self._fleet = None
+        self._worker_seq = len(workers.remote_workers)
+        self._straggler_evict = _config.get("RAY_TPU_STRAGGLER_EVICT")
         if workers.remote_workers:
             self._broadcast_weights()
             for i, w in enumerate(workers.remote_workers):
                 self._worker_tags[w] = f"w{i}"
                 for _ in range(self.max_in_flight):
                     self.sample_tasks.add(w, w.sample.remote())
+            from ..._private.fleet import FleetController
+            self._fleet = FleetController(
+                spawn=self._fleet_spawn, retire=self._fleet_retire,
+                size=lambda: len(self.workers.remote_workers))
+            self._fleet.publish()
 
     # ------------------------------------------------------------------
     @property
     def num_weight_broadcasts(self) -> int:
         return self._broadcaster.num_broadcasts
+
+    @property
+    def fleet(self):
+        """The elastic-fleet controller (None without remote workers)."""
+        return self._fleet
+
+    def _fleet_spawn(self):
+        """Mechanics of one fleet join (called by FleetController):
+        spawn the actor at a fresh index/tag, bootstrap it through the
+        versioned weight plane (delta when it still holds the current
+        base, full blob for cold joins), and prime its in-flight sample
+        requests."""
+        w = self.workers.add_worker()
+        tag = f"w{self._worker_seq}"
+        self._worker_seq += 1
+        self._worker_tags[w] = tag
+        held = None
+        try:
+            held = ray_tpu.get(w.weight_sync_version.remote())
+        except Exception:  # noqa: BLE001 — treat as a cold join
+            held = None
+        self._broadcaster.bootstrap(w, held or None)
+        for _ in range(self.max_in_flight):
+            self.sample_tasks.add(w, w.sample.remote())
+        return w, tag
+
+    def _fleet_retire(self, worker):
+        """Mechanics of one fleet removal: drain the worker's in-flight
+        sample tasks, prune its weight-sync version entry and straggler
+        ledgers, and kill the actor. `worker=None` retires the newest
+        member (shrink). Returns the retired tag (None = no-op)."""
+        if worker is None:
+            if not self.workers.remote_workers:
+                return None
+            worker = self.workers.remote_workers[-1]
+        tag = self._worker_tags.pop(worker, None)
+        if tag is None:
+            return None  # already retired (double-eviction race)
+        self.sample_tasks.remove_worker(worker)
+        self._broadcaster.remove_worker(worker)
+        self.workers.remove_worker(worker)
+        for ledger in (self._worker_sampled, self._worker_fetch_s,
+                       self._worker_fetch_n, self._worker_last_task,
+                       self._strag_prev):
+            ledger.pop(tag, None)
+        return tag
+
+    def save_learner_state(self):
+        """Checkpoint the FULL learner state through the object plane:
+        policy params + optax moments + loss state + timestep (and the
+        q8 all-reduce EF residuals when armed), plus the weight-sync
+        encoder's version counter / receiver-view base / EF residual.
+        A learner restored from the returned ref RESUMES — the
+        versioned broadcast stream continues, so surviving workers keep
+        their delta path instead of full-resyncing."""
+        state = {
+            "policy": self.workers.local_worker.policy.get_state(),
+            "weight_sync": self._broadcaster.get_state(),
+            "num_steps_sampled": self.num_steps_sampled,
+            "num_steps_trained": self.num_steps_trained,
+        }
+        return ray_tpu.put(state)
+
+    def restore_learner_state(self, state_or_ref) -> None:
+        state = state_or_ref
+        if not isinstance(state, dict):
+            state = ray_tpu.get(state_or_ref)
+        self.workers.local_worker.policy.set_state(state["policy"])
+        self._broadcaster.set_state(state["weight_sync"])
+        self.num_steps_sampled = state.get(
+            "num_steps_sampled", self.num_steps_sampled)
+        self.num_steps_trained = state.get(
+            "num_steps_trained", self.num_steps_trained)
 
     def _broadcast_weights(self):
         self._broadcaster.broadcast()
@@ -418,6 +504,7 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         """Collect finished sample tasks, refill in-flight requests, build
         train batches, and feed the learner (parity: SimpleAggregator
         `iter_train_batches` + optimizer `_step`)."""
+        from ..._private import chaos
         sampled = 0
         for worker, ref in self.sample_tasks.completed(blocking_wait=True):
             tag = self._worker_tags.get(worker)
@@ -426,6 +513,21 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
             fetch_dt = time.perf_counter() - tf0
             decompress_batch(batch)
             sampled += batch.count
+            preempted = False
+            if self._fleet is not None and tag is not None:
+                # A replacement's first harvested sample closes its
+                # actor_recovery_s clock.
+                self._fleet.note_sample(tag)
+                if chaos.controller is not None:
+                    # agent.preempt: one occurrence per harvested sample
+                    # task. A window:<start>:<period> rule turns this
+                    # into the deterministic rolling-preemption
+                    # schedule: the sampler that shipped the matching
+                    # fragment is killed and replaced mid-run.
+                    rule = chaos.controller.fire("agent.preempt", tag)
+                    if rule is not None and rule.kind == "kill":
+                        self._fleet.preempt(worker, tag)
+                        preempted = True
             if tag is not None:
                 # Per-worker throughput / fetch-latency ledger the
                 # straggler detector windows over.
@@ -456,6 +558,10 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                 self.learner.weights_updated = False
                 self._broadcast_weights()
             self.num_steps_since_broadcast += 1
+            if preempted:
+                # The worker is dead and its replacement was already
+                # primed by the fleet join path — nothing to resubmit.
+                continue
             # Version-gated sync: a worker already holding the current
             # broadcast is skipped (no redundant re-send per completed
             # sample task); behind-base workers fall back to full blobs
@@ -591,6 +697,17 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
                     if tid:
                         rt.task_events.record(tid, te.ANNOTATE,
                                               straggler=tag)
+        if flagged and self._fleet is not None and self._straggler_evict:
+            # Remediation (RAY_TPU_STRAGGLER_EVICT=1): a flagged REMOTE
+            # sampler is evicted and replaced instead of just
+            # annotated. The fleet controller throttles per tag and
+            # caps evictions per window; inline-actor tags (aK) are
+            # threads of this process — nothing to evict.
+            tag_to_worker = {t: w for w, t in self._worker_tags.items()}
+            for tag in flagged:
+                w = tag_to_worker.get(tag)
+                if w is not None:
+                    self._fleet.evict(w, tag, reason="straggler")
         if flagged and self._strag_capture is not None:
             for tag in flagged:
                 # Inline-actor tags map to threads of THIS process, so
@@ -628,6 +745,8 @@ class AsyncSamplesOptimizer(PolicyOptimizer):
         stragglers = self._update_stragglers()
         if stragglers:
             out["stragglers"] = stragglers
+        if self._fleet is not None:
+            out["fleet"] = self._fleet.stats()
         return out
 
     def stop(self):

@@ -644,12 +644,18 @@ class JaxPolicy(Policy):
             self.params = jax.device_put(weights, self._param_sh)
 
     def get_state(self):
-        return {
+        state = {
             "weights": self.get_weights(),
             "opt_state": jax.tree.map(np.asarray, self.opt_state),
             "loss_state": {k: float(v) for k, v in self.loss_state.items()},
             "global_timestep": self.global_timestep,
         }
+        if self._ef_state:
+            # q8 all-reduce error-feedback residuals: without them a
+            # restored learner re-accumulates quantization error from
+            # zero instead of resuming the compensated stream.
+            state["ef_state"] = jax.tree.map(np.asarray, self._ef_state)
+        return state
 
     def set_state(self, state):
         self.set_weights(state["weights"])
@@ -658,6 +664,10 @@ class JaxPolicy(Policy):
         self.global_timestep = state.get("global_timestep", 0)
         for k, v in state.get("loss_state", {}).items():
             self.loss_state[k] = jnp.asarray(v, jnp.float32)
+        ef = state.get("ef_state")
+        if ef and self._ef_state:
+            self._ef_state = jax.device_put(
+                jax.tree.map(jnp.asarray, ef), self._ef_sh)
 
     def update_loss_state(self, **kwargs) -> None:
         for k, v in kwargs.items():

@@ -33,6 +33,15 @@ class TaskPool:
             worker = self._tasks.pop(ref)
             yield worker, ref
 
+    def remove_worker(self, worker) -> list:
+        """Drop every in-flight task of one worker (fleet removal /
+        eviction: the refs die with the actor, so blocking on them
+        would stall the pull loop). Returns the dropped refs."""
+        refs = [ref for ref, w in self._tasks.items() if w is worker]
+        for ref in refs:
+            del self._tasks[ref]
+        return refs
+
     @property
     def count(self) -> int:
         return len(self._tasks)

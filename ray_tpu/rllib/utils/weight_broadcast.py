@@ -163,6 +163,44 @@ class WeightBroadcaster:
         """Drop a worker's version (dead or recreated worker)."""
         self._worker_versions.pop(worker, None)
 
+    def remove_worker(self, worker) -> None:
+        """Full removal: drop the worker's last-sent version AND its
+        pending set_weights acks. Without this, churn grows
+        _worker_versions (and the ack pool) one dead handle per
+        evicted/preempted worker, forever."""
+        self._worker_versions.pop(worker, None)
+        self._acks.remove_worker(worker)
+
+    def bootstrap(self, worker, held_version=None) -> bool:
+        """Rejoin path for a new/replacement worker: when the worker
+        still holds the delta base of the CURRENT version (a warm
+        rejoin — e.g. an actor that missed membership but kept its
+        decoder), route it the 4x-smaller delta; anyone else (cold
+        join, restarted process) transparently gets the full blob. A
+        wrong claim is safe: the stale-base handshake full-syncs it."""
+        if held_version is not None \
+                and held_version == self._base_version:
+            self._worker_versions[worker] = held_version
+        else:
+            self._worker_versions.pop(worker, None)
+        return self._send(worker)
+
+    def get_state(self) -> dict:
+        """Encoder state (version counter, receiver-view base, EF
+        residual) for the learner checkpoint — restoring it resumes
+        the versioned stream, so surviving workers keep their delta
+        path instead of full-resyncing after a learner restart."""
+        return self.encoder.get_state()
+
+    def set_state(self, state: dict) -> None:
+        self.encoder.set_state(state)
+        # Payload refs belong to the previous incarnation's object
+        # plane; re-derive them lazily (full_payloads is cached per
+        # version) on the next send.
+        self._payload_refs = None
+        self._base_version = None
+        self._full_refs_cache = None
+
     def stats(self) -> dict:
         return {
             "weight_sync_version": self.encoder.version,
@@ -170,4 +208,7 @@ class WeightBroadcaster:
             "weight_sync_shards": self.encoder.shard_count,
             "num_weight_sync_skipped": self.num_skipped,
             "num_weight_sync_stale_fallbacks": self.num_stale_fallbacks,
+            # Bounded by the live fleet size when removal pruning works
+            # (the churn regression asserts on it).
+            "num_weight_sync_tracked_workers": len(self._worker_versions),
         }

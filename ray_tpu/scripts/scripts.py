@@ -598,8 +598,11 @@ def cmd_chaos(args):
         seed, rules = chaos_mod.parse_spec(args.spec)
         print(f"seed: {seed}")
         for r in rules:
-            print(f"  {r.site:<16s} {r.kind:<12s} "
-                  f"{r.trigger}{r.value:g}"
+            if r.trigger == "window":
+                trig = f"window:{r.value:g}:{r.period:g}"
+            else:
+                trig = f"{r.trigger}{r.value:g}"
+            print(f"  {r.site:<16s} {r.kind:<12s} {trig}"
                   + (f" param={r.param}" if r.param else ""))
         return
     if not args.trace:
@@ -630,6 +633,59 @@ def cmd_chaos(args):
         by_kind[k] = by_kind.get(k, 0) + 1
     print(f"{len(entries)} injection(s): " + ", ".join(
         f"{k} x{n}" for k, n in sorted(by_kind.items())))
+
+
+def cmd_fleet(args):
+    """Elastic-fleet view: live fleet size, join/evict counters,
+    recovery-time quantiles (all off the head's aggregated metrics),
+    and the per-actor membership event history the FleetController
+    publishes into the head KV (`fleet:events`)."""
+    from ray_tpu._private.fleet import FLEET_EVENTS_KV_KEY
+    address = _resolve_address(args)
+    conn = _connect(address)
+    try:
+        agg = conn.request({"kind": "get_metrics"},
+                           timeout=30)["metrics"]
+        raw = conn.request({"kind": "kv_get",
+                            "key": "ikv:" + FLEET_EVENTS_KV_KEY},
+                           timeout=30).get("value")
+    finally:
+        conn.close()
+    gauges = agg.get("gauges") or {}
+    counters = agg.get("counters") or {}
+    size = gauges.get("fleet_size")
+    if size is None and not raw:
+        print("no fleet controller has published yet (fleets form "
+              "when an async optimizer runs with remote workers)")
+        return
+    print(f"fleet size: {size:g}" if size is not None
+          else "fleet size: (gauge not published)")
+    print(f"joins: {counters.get('fleet_joins_total', 0):g}  "
+          f"evictions: {counters.get('fleet_evictions_total', 0):g}")
+    q = (agg.get("quantiles") or {}).get("actor_recovery_s")
+    if q:
+        def _f(x):
+            return f"{x:.4g}s" if x is not None else "-"
+        print(f"recovery (death -> first rejoined sample): "
+              f"n={q['count']:g} p50={_f(q['p50'])} "
+              f"p95={_f(q['p95'])} max={_f(q['max'])}")
+    if raw:
+        try:
+            events = json.loads(raw)
+        except (TypeError, ValueError):
+            events = []
+        if events:
+            print(f"membership events (last {len(events)}):")
+            print(f"  {'when':<20s} {'event':<10s} {'tag':<8s} detail")
+            for e in events:
+                when = time.strftime(
+                    "%Y-%m-%d %H:%M:%S",
+                    time.localtime(e.get("ts", 0)))
+                detail = e.get("reason", "")
+                if "recovery_s" in e:
+                    detail = f"recovery_s={e['recovery_s']}"
+                print(f"  {when:<20s} {e.get('event', '?'):<10s} "
+                      f"{e.get('tag', '?'):<8s} {detail}")
 
 
 def cmd_check(args):
@@ -746,6 +802,12 @@ def main(argv=None):
                            help="dump the tunable-config registry "
                                 "(effective values; * = env override)")
         p.set_defaults(fn=fn)
+
+    p = sub.add_parser(
+        "fleet", help="elastic-fleet view: live size, join/evict "
+                      "history, recovery-time quantiles")
+    p.add_argument("--address", default=None)
+    p.set_defaults(fn=cmd_fleet)
 
     p = sub.add_parser(
         "dump", help="pretty-print a flight-recorder postmortem JSON "
