@@ -297,9 +297,9 @@ def bench_anakin(n_dev: int, flops_per_step: float = 0.0):
 # Latency histograms whose tails ride into BENCH json (the tail plane's
 # r09+ trajectory lines: median vs p99 is the straggler story).
 TAIL_HISTS = ("get_wall_s", "put_wall_s", "task_exec_s",
-              "task_queue_wait_s", "weight_sync_encode_s",
-              "weight_sync_apply_s", "wire_chunk_send_s",
-              "actor_recovery_s")
+              "task_queue_wait_s", "head_lock_wait_s",
+              "weight_sync_encode_s", "weight_sync_apply_s",
+              "wire_chunk_send_s", "actor_recovery_s")
 
 
 def snapshot_cluster_metrics():
@@ -352,6 +352,23 @@ def snapshot_cluster_metrics():
         return out
     except Exception:
         return None
+
+
+def bench_head_saturation():
+    """Fast control-plane smoke leg (PERF.md round 11): the quick
+    head-saturation sweep — raw in-process HeadServer, pre-shard
+    baseline arm (1 shard, request/response directory) vs the sharded
+    pub/sub arm — so BENCH json tracks head tasks/s, directory ops/s,
+    the scaling ratio, and the head_lock_wait_s contention counters
+    round over round. Skips the per-arm e2e burst (the surrounding
+    benches already exercise the real runtime)."""
+    from ray_tpu.ray_perf import head_saturation_benchmarks
+    try:
+        r = head_saturation_benchmarks(quick=True, e2e=False)
+        return {k: (round(v, 2) if isinstance(v, float) else v)
+                for k, v in r.items()}
+    except Exception as e:  # noqa: BLE001 - smoke leg must not sink BENCH
+        return {"error": f"{type(e).__name__}: {e}"}
 
 
 def bench_weight_sync(syncs: int = 6):
@@ -658,6 +675,9 @@ def main():
         # worker receives per broadcast, per codec arm) — the delta
         # plane's r06+ trajectory line.
         "weight_sync": bench_weight_sync(),
+        # Control-plane smoke leg: head tasks/s + directory ops/s at
+        # the pre-shard baseline vs sharded pub/sub operating points.
+        "head_saturation": bench_head_saturation(),
         "cluster_metrics": telemetry,
     }
     if kernel_mfu is not None:

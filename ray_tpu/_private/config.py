@@ -122,6 +122,33 @@ _def("RAY_TPU_MAX_UPLOADS_PER_OBJECT", int, 2,
      "replica — the bounded fan-out that turns a 1->N broadcast into "
      "a tree (only enforced while RAY_TPU_LOCATION_FETCH is on)")
 
+# --- head sharding (partitioned control plane; _private/head_shards.py)
+_def("RAY_TPU_HEAD_SHARDS", int, min(8, max(2, (os.cpu_count() or 2) // 2)),
+     "Shard count for the head's hot tables (KV store, object-location "
+     "directory, metric snapshots, task ring): keys route to "
+     "crc32(key) % N planes each behind its own lock, so concurrent "
+     "clients stop convoying on one global RLock. 1 = the unsharded "
+     "layout (single plane, still behind a shard lock). Default scales "
+     "with cores; cross-shard reads merge per-shard snapshots without "
+     "a global freeze")
+_def("RAY_TPU_DIR_CACHE", bool, True,
+     "Client-side object-location directory cache: runtime clients "
+     "subscribe to the head's per-shard objloc:<k> pub/sub channels and "
+     "serve routed-fetch source picks from a local bounded cache "
+     "invalidated by location deltas (add/remove/drop_addr), so the "
+     "steady-state fetch path issues zero head RPCs (0 reverts to one "
+     "object_locations RPC per routed fetch)")
+_def("RAY_TPU_DIR_CACHE_MAX", int, 4096,
+     "Max entries in the client-side directory cache (LRU; mirrors the "
+     "head directory cap)")
+_def("RAY_TPU_HEAD_SPAWNED_MAX", int, 4096,
+     "Reaped worker-spawn records retained by the head (live spawns "
+     "are never pruned; the bound keeps worker churn from growing the "
+     "table forever)")
+_def("RAY_TPU_HEAD_DEAD_ACTORS_MAX", int, 4096,
+     "DEAD actor records retained by the head for resolve_actor error "
+     "reporting (oldest dead records beyond the cap are pruned)")
+
 # --- worker leases ----------------------------------------------------
 _def("RAY_TPU_DISABLE_LEASES", bool, False,
      "Route every task through the head instead of worker leases")

@@ -62,8 +62,8 @@ def render(head) -> str:
         actors = [i.view() for i in head._actors.values()]
         errors = list(head._recent_errors)
         logs = list(head._recent_logs)
-    task_rows_src = head._task_log.list(limit=20)
-    state_counts = head._task_log.state_counts()
+    task_rows_src = head._shards.task_list(limit=20)
+    state_counts = head._shards.task_state_counts()
     task_states = " &middot; ".join(
         f"{s} {state_counts[s]}" for s in STATES if s in state_counts) \
         or "(none)"

@@ -2,7 +2,7 @@
 
     python -m ray_tpu.scripts check [paths...]
         [--baseline FILE] [--write-baseline] [--json] [--no-lockgraph]
-        [--race] [--stress SEED]
+        [--race] [--stress SEED] [--head-stress SEED]
 
 `--race` additionally arms the GC300 lockset data-race plane: a live
 runtime is spun up and the seeded interleaving stress harness
@@ -12,6 +12,10 @@ tables; GC301/GC302 findings join the stream and go through the same
 baseline/inline suppression. `--stress SEED` (implies --race) pins the
 seed and also verifies the trace replays byte-identical — the same
 determinism gate `scripts chaos --replay` applies to fault injection.
+`--head-stress SEED` races the sharded head instead: a raw in-process
+HeadServer with racecheck armed, N client connections mixing
+cross-shard kv/location/lease/task-event ops (stress.HeadOpsRunner),
+with the same canary + byte-identical-replay gates.
 
 Exit status: 0 when no unsuppressed findings, 1 otherwise. The
 shipped tree passes clean; `tests/test_graftcheck.py::test_self_clean`
@@ -33,7 +37,8 @@ from .reporter import print_json, print_text
 def run(paths: List[str], baseline_path: Optional[str] = None,
         write_baseline: bool = False, as_json: bool = False,
         lockgraph: bool = True, race: bool = False,
-        stress_seed: Optional[int] = None, stream=None) -> int:
+        stress_seed: Optional[int] = None,
+        head_stress_seed: Optional[int] = None, stream=None) -> int:
     paths = paths or ["ray_tpu"]
     missing = [p for p in paths if not os.path.exists(p)]
     if missing:
@@ -49,6 +54,11 @@ def run(paths: List[str], baseline_path: Optional[str] = None,
     if race or stress_seed is not None:
         rc = _run_race_leg(baseline, stress_seed, new, suppressed,
                            stream=stream)
+        if rc:
+            return rc
+    if head_stress_seed is not None:
+        rc = _run_race_leg(baseline, head_stress_seed, new, suppressed,
+                           stream=stream, head_ops=True)
         if rc:
             return rc
     if write_baseline:
@@ -68,16 +78,24 @@ def run(paths: List[str], baseline_path: Optional[str] = None,
 
 
 def _run_race_leg(baseline: Baseline, stress_seed: Optional[int],
-                  new: list, suppressed: list, stream=None) -> int:
+                  new: list, suppressed: list, stream=None,
+                  head_ops: bool = False) -> int:
     """Arm racecheck, drive the interleaving stress harness against a
-    live runtime, and fold GC30x findings into the stream. Returns a
-    non-zero exit code for harness-level failures (dead canary,
-    divergent replay); finding-level failures flow through `new`."""
+    live runtime (or, with head_ops, against a raw sharded HeadServer),
+    and fold GC30x findings into the stream. Returns a non-zero exit
+    code for harness-level failures (dead canary, divergent replay);
+    finding-level failures flow through `new`."""
     from . import stress
     out = stream or sys.stdout
     verify = stress_seed is not None
     try:
-        if verify:
+        if head_ops:
+            result = stress.run_head_stress(stress_seed)
+            if verify:
+                result["replay_identical"] = (
+                    result["trace_bytes"] == stress.run_head_stress(
+                        result["seed"])["trace_bytes"])
+        elif verify:
             result = stress.verify_replay(stress_seed)
         else:
             result = stress.run_stress()
@@ -85,7 +103,8 @@ def _run_race_leg(baseline: Baseline, stress_seed: Optional[int],
         print(f"graftcheck: race stress harness failed: "
               f"{type(e).__name__}: {e}", file=stream or sys.stderr)
         return 2
-    print(f"graftcheck: race stress seed={result['seed']} "
+    leg = "head-ops stress" if head_ops else "race stress"
+    print(f"graftcheck: {leg} seed={result['seed']} "
           f"threads={result['threads']} "
           f"ops/thread={result['ops_per_thread']} "
           f"trace={len(result['trace'])} entries", file=out)
@@ -139,11 +158,19 @@ def main(argv=None) -> int:
                         help="race-stress seed (implies --race); also "
                              "verifies the trace replays "
                              "byte-identical from the seed")
+    parser.add_argument("--head-stress", type=int, default=None,
+                        metavar="SEED", dest="head_stress",
+                        help="race the sharded head: seeded cross-"
+                             "shard kv/location/lease/task-event ops "
+                             "against a raw HeadServer with racecheck "
+                             "armed, plus the byte-identical replay "
+                             "gate")
     args = parser.parse_args(argv)
     return run(args.paths, baseline_path=args.baseline,
                write_baseline=args.write_baseline, as_json=args.json,
                lockgraph=not args.no_lockgraph, race=args.race,
-               stress_seed=args.stress)
+               stress_seed=args.stress,
+               head_stress_seed=args.head_stress)
 
 
 if __name__ == "__main__":

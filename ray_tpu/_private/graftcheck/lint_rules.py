@@ -490,6 +490,13 @@ class BlockingCallUnderLock(Rule):
             return f"{recv.id}.{f.attr}()"
         if f.attr in self._SOCKET_BLOCKERS:
             return f".{f.attr}() socket io"
+        if f.attr in ("reply", "reply_error"):
+            # conn.reply()/reply_error() pickles the payload and writes
+            # the frame — serialization + socket io on the caller's
+            # thread. Holding a table lock across it convoys every
+            # handler behind one slow consumer (the head-sharding PR's
+            # motivating GC109 shape).
+            return f".{f.attr}() reply serialization + socket io"
         if f.attr == "join":
             # Thread joins only: a Name or self-attr receiver with no
             # argument or a numeric timeout — excludes ",".join(xs),

@@ -138,6 +138,14 @@ def _lock_ctor_kind(value: ast.expr) -> Optional[str]:
     if name in ("make_lock", "make_rlock", "make_condition"):
         return {"make_lock": "lock", "make_rlock": "rlock",
                 "make_condition": "condition"}[name]
+    # An instrumentation wrapper constructed around a lock ctor — e.g.
+    # `self._lock = _TimedRLock(make_rlock("HeadShard._lock"), self)`
+    # (head_shards.py) — IS that lock: look through positional args so
+    # timing shims don't blind the graph.
+    for a in value.args:
+        inner = _lock_ctor_kind(a)
+        if inner:
+            return inner
     return None
 
 

@@ -243,6 +243,200 @@ class InterleaveRunner:
             ray_tpu.shutdown()
 
 
+_HEAD_OPS = ("kv_put", "kv_get", "kv_keys", "loc_add", "loc_lookup",
+             "lease", "task_event")
+
+
+class HeadOpsRunner:
+    """Seeded head-op interleaving stress for the sharded control
+    plane (the HeadServer analog of InterleaveRunner). Boots a raw
+    in-process HeadServer with racecheck armed — the shard planes'
+    tables and locks are traced — then races N barrier-started
+    protocol clients, each on its OWN connection (so handler threads
+    really interleave), through per-seed scripts mixing KV put/get,
+    cross-shard kv_keys merges, object-location add/lookup, unfittable
+    lease request/cancel, and task-event pushes.
+
+    Same determinism contract as InterleaveRunner: thread t's script
+    is ``random.Random(f"{seed}:{t}")``; threads touch only their own
+    keys/object-ids/task-ids (which still SPREAD over shards — routing
+    is crc32 of the key, not of the thread); recorded details are
+    outcomes, never runtime ids — so ``trace_bytes`` replays
+    byte-identical run to run.
+    """
+
+    def __init__(self, seed: int, threads: int = 4,
+                 ops_per_thread: int = 24, shards: int = 4):
+        self.seed = int(seed)
+        self.threads = int(threads)
+        self.ops_per_thread = int(ops_per_thread)
+        self.shards = int(shards)
+
+    def _script(self, t: int) -> List[dict]:
+        rng = random.Random(f"{self.seed}:{t}")
+        weights = {"kv_put": 5, "kv_get": 4, "kv_keys": 2, "loc_add": 4,
+                   "loc_lookup": 3, "lease": 2, "task_event": 4}
+        ops = [op for op in _HEAD_OPS if weights[op]]
+        return [{"op": rng.choices(
+                    ops, weights=[weights[o] for o in ops])[0],
+                 "pick": rng.random(),
+                 "size": rng.randrange(8, 128)}
+                for _ in range(self.ops_per_thread)]
+
+    def run(self) -> dict:
+        import shutil
+        import tempfile
+
+        from .. import config
+        from .. import metrics as metrics_mod
+        config.set_override("RAY_TPU_RACECHECK", 1)
+        config.set_override("RAY_TPU_HEAD_SHARDS", self.shards)
+        runtime_trace.reset_state()
+        racecheck.reset_state()
+        metrics_mod.reset()
+        session_dir = tempfile.mkdtemp(prefix="ray_tpu_headstress_")
+        try:
+            canary_ok = plant_canary()
+            trace = self._run_armed(session_dir)
+            findings = [f for f in racecheck.get_findings()
+                        if f.context != CANARY_STRUCT]
+        finally:
+            config.clear_override("RAY_TPU_RACECHECK")
+            config.clear_override("RAY_TPU_HEAD_SHARDS")
+            runtime_trace.reset_state()
+            racecheck.reset_state()
+            metrics_mod.reset()
+            shutil.rmtree(session_dir, ignore_errors=True)
+        trace.sort(key=lambda e: (e["thread"], e["seq"]))
+        return {"seed": self.seed, "threads": self.threads,
+                "ops_per_thread": self.ops_per_thread,
+                "canary_ok": canary_ok, "trace": trace,
+                "trace_bytes": trace_bytes(trace),
+                "findings": findings}
+
+    def _run_armed(self, session_dir: str) -> List[dict]:
+        from .. import head as head_mod
+        from .. import protocol
+        from ..ids import ObjectID
+        head = head_mod.HeadServer(session_dir, "headstress",
+                                   {"CPU": 1.0})
+        conns = [
+            protocol.connect(head.sock_path, f"stress-head-{t}",
+                             lambda c, m: None,
+                             hello_extra={"role": "probe"})
+            for t in range(self.threads)]
+        barrier = threading.Barrier(self.threads)
+        traces: List[List[dict]] = [[] for _ in range(self.threads)]
+        errors: List[BaseException] = []
+        try:
+            def worker(t: int):
+                conn = conns[t]
+                script = self._script(t)
+                # Per-thread deterministic key/oid/tid universes; the
+                # crc32 routing spreads them across every shard.
+                oids = [ObjectID(random.Random(
+                    f"{self.seed}:{t}:oid:{i}").randbytes(20))
+                    for i in range(6)]
+                written: Dict[str, str] = {}
+                located: Dict[int, int] = {}
+                barrier.wait(timeout=30)
+                for seq, step in enumerate(script):
+                    op = step["op"]
+                    try:
+                        if op == "kv_put":
+                            key = f"sk:{t}:{int(step['pick'] * 8)}"
+                            payload = random.Random(
+                                f"{self.seed}:{t}:{seq}").randbytes(
+                                    step["size"])
+                            r = conn.request(
+                                {"kind": "kv_put", "key": key,
+                                 "value": payload}, timeout=30)
+                            written[key] = _checksum(payload)
+                            detail = {"key": key, "ok": r.get("ok")}
+                        elif op == "kv_get" and written:
+                            keys = sorted(written)
+                            key = keys[int(step["pick"] * len(keys))]
+                            r = conn.request(
+                                {"kind": "kv_get", "key": key},
+                                timeout=30)
+                            got = r.get("value") or b""
+                            detail = {"key": key,
+                                      "ok": _checksum(got)
+                                      == written[key]}
+                        elif op == "kv_keys":
+                            # Cross-shard merged read of OWN prefix.
+                            r = conn.request(
+                                {"kind": "kv_keys",
+                                 "prefix": f"sk:{t}:"}, timeout=30)
+                            detail = {"n": len(r.get("keys") or ())}
+                        elif op == "loc_add":
+                            i = int(step["pick"] * len(oids))
+                            conn.send({"kind": "object_location_add",
+                                       "object_id": oids[i],
+                                       "addr": f"a{t}.{seq}",
+                                       "node_id": f"n{t}"})
+                            located[i] = located.get(i, 0) + 1
+                            detail = {"i": i}
+                        elif op == "loc_lookup" and located:
+                            ks = sorted(located)
+                            i = ks[int(step["pick"] * len(ks))]
+                            # Same-conn ordering: every prior add for
+                            # this oid has been applied.
+                            r = conn.request(
+                                {"kind": "object_locations",
+                                 "object_id": oids[i]}, timeout=30)
+                            n = len(r.get("locations") or ())
+                            detail = {"i": i, "ok": n == located[i]}
+                        elif op == "lease":
+                            # Unfittable shape: deterministically
+                            # queued (never granted), then cancelled.
+                            res = {"STRESS": 1.0}
+                            conn.send({"kind": "request_lease",
+                                       "resources": res, "count": 1})
+                            conn.send(
+                                {"kind": "cancel_lease_requests",
+                                 "resources": res, "count": 1})
+                            detail = {"queued": True}
+                        elif op == "task_event":
+                            tid = random.Random(
+                                f"{self.seed}:{t}:tid:"
+                                f"{int(step['pick'] * 6)}").randbytes(
+                                    16).hex()
+                            conn.send({
+                                "kind": "task_events", "events": [
+                                    {"task_id": tid,
+                                     "state": "QUEUED",
+                                     "ts": float(seq),
+                                     "name": f"stress-{t}"}]})
+                            detail = {"tid": tid[:8]}
+                        else:
+                            detail = {"skip": True}
+                    except Exception as e:  # noqa: BLE001 - trace it
+                        detail = {"error": type(e).__name__}
+                    traces[t].append({"thread": t, "seq": seq,
+                                      "op": op, "detail": detail})
+
+            threads = [threading.Thread(target=worker, args=(t,),
+                                        name=f"headstress-{t}")
+                       for t in range(self.threads)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=120)
+                if th.is_alive():
+                    errors.append(TimeoutError(f"{th.name} wedged"))
+            if errors:
+                raise errors[0]
+            return [e for tr in traces for e in tr]
+        finally:
+            for c in conns:
+                try:
+                    c.close()
+                except Exception:
+                    pass
+            head.shutdown()
+
+
 def run_stress(seed: Optional[int] = None, threads: int = 3,
                ops_per_thread: int = 16, use_actors: bool = True) -> dict:
     """One stress run at `seed` (default: RAY_TPU_RACE_STRESS_SEED)."""
@@ -252,6 +446,19 @@ def run_stress(seed: Optional[int] = None, threads: int = 3,
     return InterleaveRunner(seed, threads=threads,
                             ops_per_thread=ops_per_thread,
                             use_actors=use_actors).run()
+
+
+def run_head_stress(seed: Optional[int] = None, threads: int = 4,
+                    ops_per_thread: int = 24, shards: int = 4) -> dict:
+    """One sharded-head stress run at `seed` (default:
+    RAY_TPU_RACE_STRESS_SEED). Surfaced as `scripts check
+    --head-stress SEED`."""
+    if seed is None:
+        from .. import config
+        seed = config.get("RAY_TPU_RACE_STRESS_SEED")
+    return HeadOpsRunner(seed, threads=threads,
+                         ops_per_thread=ops_per_thread,
+                         shards=shards).run()
 
 
 def verify_replay(seed: Optional[int] = None, **kw) -> dict:

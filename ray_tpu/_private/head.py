@@ -38,7 +38,7 @@ from typing import Dict, List, Optional, Set
 from ..exceptions import ActorDiedError, WorkerCrashedError
 from .ids import ActorID, TaskID
 from .task_spec import ACTOR_CREATION_TASK, TaskSpec
-from . import chaos, config, protocol, task_events
+from . import chaos, config, head_shards, protocol, task_events
 from .graftcheck import racecheck
 from .graftcheck.runtime_trace import make_rlock
 
@@ -150,8 +150,14 @@ class HeadServer:
         if ctl is not None and not ctl.once_dir:
             ctl.once_dir = session_dir
 
+        # Residual global lock: scheduler state only (nodes, workers,
+        # leases, pending queue, actors, conns, subs). The hot tables —
+        # KV, object-location directory, metric snapshots, task ring —
+        # live in crc32-routed shard planes (head_shards.py), each
+        # behind its own lock. Ordering: HeadServer._lock may be held
+        # while taking a HeadShard._lock, never the reverse.
         self._lock = make_rlock("HeadServer._lock")
-        self._kv: Dict[str, bytes] = {}
+        self._shards = head_shards.HeadShards(obj_locations_max=4096)
         self._subs: Dict[str, Set[protocol.Connection]] = {}
         self._nodes: Dict[str, NodeInfo] = {
             "node0": NodeInfo("node0", resources)}
@@ -178,11 +184,15 @@ class HeadServer:
         self._captures: Dict[str, dict] = {}
         self._capture_threads: List[threading.Thread] = []
         self._capture_counter = 0
-        # Task-lifecycle ring (task_events.py; parity: GCS task events):
-        # every submit/queue/lease/run/finish transition in the cluster
-        # lands here, bounded, serving the state API + dashboard.
-        self._task_log = task_events.TaskStateLog(
-            config.get("RAY_TPU_TASK_LOG_MAX"))
+        # Task-lifecycle transitions land in the shard planes' ring
+        # segments (routed by task id); `_shards.task_list()` etc.
+        # merge them for the state API + dashboard.
+        # Bounded-table caps for the residual global tables: reaped
+        # spawn records and DEAD actor records survive for diagnostics
+        # but must not grow with cluster-lifetime churn.
+        self._spawned_max = max(16, config.get("RAY_TPU_HEAD_SPAWNED_MAX"))
+        self._dead_actors_max = max(
+            16, config.get("RAY_TPU_HEAD_DEAD_ACTORS_MAX"))
         # Deadline-driven node liveness (reference: 100 ms heartbeats x
         # num_heartbeats_timeout=300, `ray_config_def.h:24,28` +
         # `raylet/monitor.cc`): agents heartbeat into the head; a node
@@ -201,31 +211,16 @@ class HeadServer:
         self._recent_errors: deque = deque(maxlen=50)
         self._recent_logs: deque = deque(maxlen=200)
         # Object location directory (parity: the reference
-        # ObjectDirectory over GCS object tables, `object_directory.h`):
-        # oid -> {process addr: node_id} for every node that sealed a
-        # fetched copy. Best-effort — stale entries are tolerated, the
-        # fetch falls back to the owner on a miss. `_grants` counts how
-        # often each replica was handed out as a source, so resolution
-        # can order least-loaded first. Bounded LRU.
-        from collections import OrderedDict as _OD
-        self._obj_locations: "_OD[object, Dict[str, str]]" = \
-            racecheck.traced_shared(_OD(), "HeadServer._obj_locations")
-        self._obj_location_grants: Dict[str, int] = \
-            racecheck.traced_shared(
-                {}, "HeadServer._obj_location_grants")
-        self._obj_locations_max = 4096
-        # Per-process metric snapshots pushed by workers/drivers
-        # (addr -> {"node":, "counters":, "gauges":}).
-        self._metric_snaps: Dict[str, dict] = racecheck.traced_shared(
-            {}, "HeadServer._metric_snaps")
-        # COUNTERS of processes that died or disconnected, folded per
-        # node: a counter is a cluster-lifetime total, so a killed
-        # worker's tasks_executed / chaos_injections_total must not
-        # vanish with its connection (gauges are point-in-time and DO
-        # die with the process).
-        self._dead_counters: Dict[str, Dict[str, float]] = \
-            racecheck.traced_shared({}, "HeadServer._dead_counters")
+        # ObjectDirectory over GCS object tables, `object_directory.h`)
+        # and per-process metric snapshots both live in the shard
+        # planes now. Location deltas additionally publish on the
+        # per-shard `objloc:<k>` channels so runtime clients keep a
+        # local directory cache (zero head RPCs on the steady-state
+        # routed-fetch path).
         self._metrics_http = None
+        # Per-shard occupancy sampling state (monitor loop): last
+        # (monotonic ts, [lock_held_s per shard]).
+        self._occ_last: Optional[tuple] = None
         # Rate ring: bounded trailing window of (ts, counter totals)
         # snapshots the monitor loop appends, so rates() can report
         # tasks/s / wire bytes/s deltas instead of lifetime totals.
@@ -316,23 +311,20 @@ class HeadServer:
         with self._lock:
             self._conns_by_addr.pop(conn.peer_addr, None)
             self._drivers.discard(conn)
-            snap = self._metric_snaps.pop(conn.peer_addr, None)
-            if snap is not None:
-                dead = self._dead_counters.setdefault(
-                    snap.get("node") or "node0", {})
-                for k, v in (snap.get("counters") or {}).items():
-                    dead[k] = dead.get(k, 0.0) + v
             for subs in self._subs.values():
                 subs.discard(conn)
-            # A dead process's sealed replicas died with its node store
-            # access: drop its directory registrations so fetches stop
-            # routing at it.
-            for oid in list(self._obj_locations):
-                entry = self._obj_locations[oid]
-                if entry.pop(conn.peer_addr, None) is not None \
-                        and not entry:
-                    del self._obj_locations[oid]
-            self._obj_location_grants.pop(conn.peer_addr, None)
+        # Shard-plane cleanup (outside the global lock): fold the dead
+        # process's counters and drop its directory registrations so
+        # fetches stop routing at it.
+        self._shards.shard_for(conn.peer_addr).fold_dead(conn.peer_addr)
+        self._shards.drop_addr(conn.peer_addr)
+        # One batched invalidation per shard channel: client directory
+        # caches scrub every entry naming the dead addr (cheaper than
+        # one remove delta per object, and it also covers entries the
+        # head's bounded directory already LRU-evicted).
+        for k in range(self._shards.nshards):
+            self._publish(head_shards.objloc_channel(k),
+                          {"op": "drop_addr", "addr": conn.peer_addr})
         self._release_leases_of(conn.peer_addr)
         if node_id is not None:
             self._handle_node_death(node_id)
@@ -348,32 +340,30 @@ class HeadServer:
             return
         fn(conn, msg)
 
-    # -- kv / pubsub -----------------------------------------------------
+    # -- kv / pubsub (shard planes; the global lock is never taken) ------
     def _h_kv_put(self, conn, msg):
-        with self._lock:
-            exists = msg["key"] in self._kv
-            if not (msg.get("overwrite", True) is False and exists):
-                self._kv[msg["key"]] = msg["value"]
+        stored, existed = self._shards.shard_for(msg["key"]).kv_put(
+            msg["key"], msg["value"], msg.get("overwrite", True))
         if "seq" in msg:
-            conn.reply(msg, ok=not exists or msg.get("overwrite", True),
-                       existed=exists)
+            conn.reply(msg, ok=stored, existed=existed)
 
     def _h_kv_get(self, conn, msg):
-        with self._lock:
-            val = self._kv.get(msg["key"])
+        val = self._shards.shard_for(msg["key"]).kv_get(msg["key"])
         conn.reply(msg, value=val)
 
     def _h_kv_del(self, conn, msg):
-        with self._lock:
-            self._kv.pop(msg["key"], None)
+        self._shards.shard_for(msg["key"]).kv_del(msg["key"])
         if "seq" in msg:
             conn.reply(msg, ok=True)
 
     def _h_kv_keys(self, conn, msg):
-        prefix = msg.get("prefix", "")
-        with self._lock:
-            keys = [k for k in self._kv if k.startswith(prefix)]
-        conn.reply(msg, keys=keys)
+        # Cross-shard merge: per-shard snapshots, no global freeze.
+        conn.reply(msg, keys=self._shards.kv_keys(msg.get("prefix", "")))
+
+    def _h_head_shard_info(self, conn, msg):
+        """Shard topology for runtime clients: the shard count fixes
+        the objloc:<k> channel set a directory cache subscribes to."""
+        conn.reply(msg, shards=self._shards.nshards)
 
     def _h_set_resource(self, conn, msg):
         """Live per-node resource adjustment (parity:
@@ -388,27 +378,35 @@ class HeadServer:
         with self._lock:
             node = self._nodes.get(node_id)
             if node is None or not node.alive:
-                conn.reply(msg, ok=False,
-                           message=f"no live node {node_id!r}")
-                return
-            old = node.total.get(name, 0.0)
-            if capacity <= 0:
-                # Deletion must keep in-use amounts as debt: dropping
-                # `available` outright would let running tasks' release
-                # resurrect phantom capacity on a deleted resource.
-                node.total.pop(name, None)
-                remaining = node.available.get(name, 0.0) - old
-                if remaining == 0:
-                    node.available.pop(name, None)
-                else:
-                    node.available[name] = remaining
+                missing = True
             else:
-                node.total[name] = capacity
-                node.available[name] = node.available.get(name, 0.0) \
-                    + (capacity - old)
-            self._schedule_locked()
-            self._serve_lease_queue_locked()
+                missing = False
+                self._apply_resource_locked(node, name, capacity)
+        if missing:
+            # Reply serialization is socket I/O: outside the lock.
+            conn.reply(msg, ok=False, message=f"no live node {node_id!r}")
+            return
         conn.reply(msg, ok=True)
+
+    def _apply_resource_locked(self, node: NodeInfo, name: str,
+                               capacity: float):
+        old = node.total.get(name, 0.0)
+        if capacity <= 0:
+            # Deletion must keep in-use amounts as debt: dropping
+            # `available` outright would let running tasks' release
+            # resurrect phantom capacity on a deleted resource.
+            node.total.pop(name, None)
+            remaining = node.available.get(name, 0.0) - old
+            if remaining == 0:
+                node.available.pop(name, None)
+            else:
+                node.available[name] = remaining
+        else:
+            node.total[name] = capacity
+            node.available[name] = node.available.get(name, 0.0) \
+                + (capacity - old)
+        self._schedule_locked()
+        self._serve_lease_queue_locked()
 
     def _h_subscribe(self, conn, msg):
         with self._lock:
@@ -453,22 +451,30 @@ class HeadServer:
 
     # -- metrics (reference: src/ray/stats/ + reporter.py) ---------------
     def _h_metrics_push(self, conn, msg):
-        with self._lock:
-            self._metric_snaps[conn.peer_addr] = {
+        # Snapshot storage is sharded by pusher address; no global lock,
+        # no reply (fire-and-forget push).
+        self._shards.shard_for(conn.peer_addr).metrics_push(
+            conn.peer_addr, {
                 "node": msg.get("node", ""),
                 "counters": msg.get("counters") or {},
                 "gauges": msg.get("gauges") or {},
                 "hists": msg.get("hists") or {},
                 "rollups": msg.get("rollups") or {},
-            }
+            })
+
+    def _merged_metric_snaps(self) -> dict:
+        """Per-shard metric snapshots + folded dead-process counters,
+        merged one shard lock at a time (no global freeze)."""
+        snaps, dead_counters = self._shards.metrics_merged()
+        for node, dead in dead_counters.items():
+            snaps[f"__dead__{node}"] = {
+                "node": node, "counters": dict(dead), "gauges": {}}
+        return snaps
 
     def _aggregated_metrics(self) -> dict:
         from . import metrics as metrics_mod
+        snaps = self._merged_metric_snaps()
         with self._lock:
-            snaps = dict(self._metric_snaps)
-            for node, dead in self._dead_counters.items():
-                snaps[f"__dead__{node}"] = {
-                    "node": node, "counters": dict(dead), "gauges": {}}
             head_counters = {
                 "head_pending_tasks": float(len(self._pending)),
                 "head_inflight_tasks": float(len(self._inflight)),
@@ -483,6 +489,13 @@ class HeadServer:
                     1 for a in self._actors.values()
                     if a.state == ALIVE)),
             }
+        # Shard-plane health: per-shard table sizes and lock contention
+        # totals, merged without a global freeze.
+        for st in self._shards.stats():
+            k = st["shard"]
+            head_counters[f"head_shard_kv.s{k}"] = float(st["kv_keys"])
+            head_counters[f"head_shard_locations.s{k}"] = \
+                float(st["obj_locations"])
         agg = metrics_mod.aggregate(snaps)
         # Head-derived quantities are point-in-time gauges.
         agg["gauges"].update(head_counters)
@@ -499,12 +512,7 @@ class HeadServer:
         rates() reads deltas off the ring, so `stat --rates` and the
         dashboard report tasks/s and wire bytes/s over a trailing window
         instead of lifetime totals."""
-        from . import metrics as metrics_mod
-        with self._lock:
-            snaps = dict(self._metric_snaps)
-            for node, dead in self._dead_counters.items():
-                snaps[f"__dead__{node}"] = {
-                    "node": node, "counters": dict(dead)}
+        snaps = self._merged_metric_snaps()
         counters: Dict[str, float] = {}
         for snap in snaps.values():
             for k, v in (snap.get("counters") or {}).items():
@@ -590,8 +598,8 @@ class HeadServer:
             "recovery_s": (agg.get("quantiles") or {}).get(
                 "actor_recovery_s"),
         }
-        with self._lock:
-            raw_events = self._kv.get("ikv:fleet:events")
+        raw_events = self._shards.shard_for(
+            "ikv:fleet:events").kv_get("ikv:fleet:events")
         if raw_events:
             try:
                 fleet_sec["events"] = json.loads(raw_events)
@@ -601,14 +609,15 @@ class HeadServer:
             "ts": time.time(),
             "session_dir": self.session_dir,
             "metrics": agg,
-            "tasks": self._task_log.list(limit=200),
-            "task_state_counts": self._task_log.state_counts(),
+            "tasks": self._shards.task_list(limit=200),
+            "task_state_counts": self._shards.task_state_counts(),
             "spans": spans,
             "nodes": nodes,
             "workers_registered": workers,
             "recent_errors": errors,
             "profiling": profiling_sec,
             "fleet": fleet_sec,
+            "head_shards": self._shards.stats(),
         }
 
     def _h_debug_dump(self, conn, msg):
@@ -676,51 +685,44 @@ class HeadServer:
             except protocol.ConnectionClosed:
                 pass
 
-    # -- object location directory (distribution plane) ------------------
+    # -- object location directory (distribution plane, sharded) ---------
     def _h_object_location_add(self, conn, msg):
-        """A node sealed a fetched copy: register it (fire-and-forget)."""
+        """A node sealed a fetched copy: register it (fire-and-forget)
+        on the object's shard plane, then publish the delta on that
+        shard's `objloc:<k>` channel so client directory caches update
+        without polling the head."""
         oid = msg["object_id"]
-        with self._lock:
-            entry = self._obj_locations.get(oid)
-            if entry is None:
-                entry = self._obj_locations[oid] = {}
-                while len(self._obj_locations) > self._obj_locations_max:
-                    self._obj_locations.popitem(last=False)
-            entry[msg["addr"]] = msg.get("node_id", "")
+        k = self._shards.shard_index(oid)
+        fresh = self._shards.planes[k].location_add(
+            oid, msg["addr"], msg.get("node_id", ""))
+        if fresh:
+            self._publish(head_shards.objloc_channel(k), {
+                "op": "add", "object_id": oid,
+                "addr": msg["addr"], "node": msg.get("node_id", "")})
 
     def _h_object_location_remove(self, conn, msg):
-        """Eviction/free deregisters the copy (fire-and-forget)."""
+        """Eviction/free deregisters the copy (fire-and-forget) and
+        publishes an invalidation delta to the shard channel."""
         oid = msg["object_id"]
-        with self._lock:
-            entry = self._obj_locations.get(oid)
-            if entry is not None:
-                entry.pop(msg["addr"], None)
-                if not entry:
-                    del self._obj_locations[oid]
+        k = self._shards.shard_index(oid)
+        removed = self._shards.planes[k].location_remove(oid, msg["addr"])
+        if removed:
+            self._publish(head_shards.objloc_channel(k), {
+                "op": "remove", "object_id": oid, "addr": msg["addr"]})
 
     def _h_object_locations(self, conn, msg):
         """Resolve an object's replica set, least-loaded first. The
-        head bumps the grant count of the replica it lists first (the
+        shard bumps the grant count of the replica it lists first (the
         borrower's predicted pick), so consecutive borrowers spread
         over the copies instead of dog-piling one."""
         oid = msg["object_id"]
-        with self._lock:
-            entry = self._obj_locations.get(oid) or {}
-            locs = sorted(
-                entry.items(),
-                key=lambda kv: self._obj_location_grants.get(kv[0], 0))
-            if locs:
-                first = locs[0][0]
-                self._obj_location_grants[first] = \
-                    self._obj_location_grants.get(first, 0) + 1
+        locs = self._shards.shard_for(oid).locations(oid)
         conn.reply(msg, locations=[{"addr": a, "node": n}
                                    for a, n in locs])
 
     def object_location_counts(self) -> Dict[str, int]:
         """Replica count per tracked object (`ray_tpu stat`, tests)."""
-        with self._lock:
-            return {oid.hex(): len(entry)
-                    for oid, entry in self._obj_locations.items()}
+        return self._shards.location_counts()
 
     # -- tasks -----------------------------------------------------------
     def _h_submit_task(self, conn, msg):
@@ -737,7 +739,7 @@ class HeadServer:
     def _record_task(self, spec: TaskSpec, state: str, **attrs):
         kind = "actor_creation" if spec.kind == ACTOR_CREATION_TASK \
             else "task"
-        self._task_log.apply({
+        self._shards.apply_task_event({
             "task_id": spec.task_id.hex(), "state": state,
             "ts": time.time(), "name": spec.describe(), "kind": kind,
             "caller": spec.caller_addr or None,
@@ -804,7 +806,7 @@ class HeadServer:
                 - node.spawning_pool - len(node.idle)
             for _ in range(min(need, max(0, cap))):
                 try:
-                    self._spawn_worker(node, dedicated=False)
+                    self._spawn_worker_locked(node, dedicated=False)
                 except Exception:
                     # One bad node must not block growth on the others.
                     logger.exception("failed to grow pool on %s",
@@ -937,17 +939,22 @@ class HeadServer:
     # -- actors ----------------------------------------------------------
     def _h_create_actor(self, conn, msg):
         spec: TaskSpec = msg["spec"]
+        # Claim the name on its KV shard BEFORE touching scheduler
+        # state: the shard's put-if-absent is the atomic registration
+        # primitive, and doing it first keeps the error reply (socket
+        # I/O) outside every lock and avoids global->shard nesting.
+        if spec.name:
+            key = "named_actor:" + spec.name
+            claimed = self._shards.shard_for(key).kv_put_if_absent(
+                key, spec.actor_id.binary())
+            if not claimed:
+                conn.reply(msg, error=ValueError(
+                    f"actor name {spec.name!r} already taken"))
+                return
         self._record_task(spec, task_events.QUEUED)
         with self._lock:
             info = ActorInfo(spec)
             self._actors[spec.actor_id] = info
-            if spec.name:
-                key = "named_actor:" + spec.name
-                if key in self._kv:
-                    conn.reply(msg, error=ValueError(
-                        f"actor name {spec.name!r} already taken"))
-                    return
-                self._kv[key] = spec.actor_id.binary()
             self._pending.append(spec)
             self._schedule_locked()
         conn.reply(msg, ok=True)
@@ -1071,8 +1078,11 @@ class HeadServer:
         conn.reply(msg, info=view)
 
     def _h_get_named_actor(self, conn, msg):
+        # Name lookup on the KV shard, then the actor view under the
+        # global lock — sequential, never nested.
+        key = "named_actor:" + msg["name"]
+        raw = self._shards.shard_for(key).kv_get(key)
         with self._lock:
-            raw = self._kv.get("named_actor:" + msg["name"])
             info = self._actors.get(ActorID(raw)) if raw else None
             view = info.view() if info else None
         conn.reply(msg, info=view)
@@ -1082,13 +1092,17 @@ class HeadServer:
         no_restart = msg.get("no_restart", True)
         with self._lock:
             info = self._actors.get(actor_id)
-            if info is None or info.state == DEAD:
-                if "seq" in msg:
-                    conn.reply(msg, ok=True)
-                return
-            if no_restart:
-                info.restarts_left = 0
-            w = self._workers.get(info.addr) if info.addr else None
+            gone = info is None or info.state == DEAD
+            w = None
+            if not gone:
+                if no_restart:
+                    info.restarts_left = 0
+                w = self._workers.get(info.addr) if info.addr else None
+        # Reply serialization is socket I/O: outside the lock (GC109).
+        if gone:
+            if "seq" in msg:
+                conn.reply(msg, ok=True)
+            return
         if w is not None:
             self._kill_worker(w)
         if "seq" in msg:
@@ -1117,6 +1131,10 @@ class HeadServer:
 
     # -- introspection ---------------------------------------------------
     def _h_cluster_info(self, conn, msg):
+        # Directory counts merge per-shard snapshots outside the global
+        # lock (consistent-per-shard cut; no global freeze).
+        loc_counts = sorted(self._shards.location_counts().items(),
+                            key=lambda kv: -kv[1])
         with self._lock:
             nodes = {nid: n.view() for nid, n in self._nodes.items()}
             total: Dict[str, float] = {}
@@ -1126,10 +1144,6 @@ class HeadServer:
                     total[k] = total.get(k, 0.0) + v
                 for k, v in n.available.items():
                     avail[k] = avail.get(k, 0.0) + v
-            loc_counts = sorted(
-                ((oid.hex(), len(entry))
-                 for oid, entry in self._obj_locations.items()),
-                key=lambda kv: -kv[1])
             info = {
                 "total_resources": total,
                 "available_resources": avail,
@@ -1142,7 +1156,7 @@ class HeadServer:
                 # Distribution plane: how many nodes hold a sealed copy
                 # of each directory-tracked object (top 20 by count).
                 "object_locations": {
-                    "objects": len(self._obj_locations),
+                    "objects": len(loc_counts),
                     "replicas": sum(n for _, n in loc_counts),
                     "top": loc_counts[:20],
                 },
@@ -1309,7 +1323,7 @@ class HeadServer:
     # -- task lifecycle state API (task_events.py) -----------------------
     def _h_task_events(self, conn, msg):
         for ev in msg.get("events", ()):
-            self._task_log.apply(ev)
+            self._shards.apply_task_event(ev)
 
     def _h_task_alive(self, conn, msg):
         """Owner-side lost-update backstop (runtime._producer_confirmed):
@@ -1325,11 +1339,11 @@ class HeadServer:
     def _h_get_tasks(self, conn, msg):
         conn.reply(
             msg,
-            tasks=self._task_log.list(state=msg.get("state"),
-                                      name=msg.get("name"),
-                                      limit=msg.get("limit", 100)),
-            summary=self._task_log.summary(),
-            state_counts=self._task_log.state_counts())
+            tasks=self._shards.task_list(state=msg.get("state"),
+                                         name=msg.get("name"),
+                                         limit=msg.get("limit", 100)),
+            summary=self._shards.task_summary(),
+            state_counts=self._shards.task_state_counts())
 
     # ------------------------------------------------------------------
     # scheduling (lease grant) — runs under self._lock
@@ -1376,7 +1390,7 @@ class HeadServer:
                 continue
             for _ in range(max(0, need - node.spawning_pool)):
                 try:
-                    self._spawn_worker(node, dedicated=False)
+                    self._spawn_worker_locked(node, dedicated=False)
                 except Exception:
                     logger.exception("failed to grow pool on %s", node_id)
                     break
@@ -1399,7 +1413,7 @@ class HeadServer:
                 if info is None:
                     continue
                 try:
-                    w = self._spawn_worker(node, dedicated=True,
+                    w = self._spawn_worker_locked(node, dedicated=True,
                                            extra_env=spec.env_vars)
                 except Exception as e:
                     # A bad spawn (e.g. unpicklable env) must not abort the
@@ -1470,7 +1484,7 @@ class HeadServer:
         self._token_counter += 1
         return f"w{self._token_counter}-{os.urandom(3).hex()}"
 
-    def _spawn_worker(self, node: NodeInfo, dedicated: bool,
+    def _spawn_worker_locked(self, node: NodeInfo, dedicated: bool,
                       extra_env: Optional[dict] = None) -> WorkerInfo:
         token = self._next_token()
         if node.conn is None:
@@ -1517,6 +1531,26 @@ class HeadServer:
         )
         return WorkerInfo("node0", token, proc=proc)
 
+    def _sample_shard_occupancy(self, now: float):
+        """Per-shard lock duty cycle over the last sample window —
+        delta(lock_held_s) / delta(wall) — published as the
+        `head_shard_occupancy.s<k>` mean gauges (`scripts stat
+        --metrics`, flight recorder). Reads the shards' cumulative
+        held-time counters without their locks: a torn float read only
+        skews one 2s sample, and taking N locks from the monitor loop
+        would perturb the very contention being measured."""
+        from . import metrics as metrics_mod
+        held = [p.lock_held_s for p in self._shards.planes]
+        if self._occ_last is not None:
+            t0, prev = self._occ_last
+            dt = now - t0
+            if dt > 0:
+                for k in range(len(held)):
+                    frac = max(0.0, min(1.0, (held[k] - prev[k]) / dt))
+                    metrics_mod.set_gauge(
+                        f"head_shard_occupancy.s{k}", frac, rollup="mean")
+        self._occ_last = (now, held)
+
     # ------------------------------------------------------------------
     # death detection (reference: raylet monitor heartbeats + SIGCHLD)
     # ------------------------------------------------------------------
@@ -1530,6 +1564,8 @@ class HeadServer:
                     and now - self._rate_last_sample >= self._rate_interval:
                 self._rate_last_sample = now
                 self._sample_rate_ring()
+            if self._occ_last is None or now - self._occ_last[0] >= 2.0:
+                self._sample_shard_occupancy(now)
             with self._lock:
                 for w in self._spawned.values():
                     if w.proc is not None and w.proc.poll() is not None \
@@ -1635,6 +1671,7 @@ class HeadServer:
                 spec.retries_used += 1
                 self._pending.append(spec)
             self._schedule_locked()
+            self._prune_spawned_locked()
 
         if actor_id is not None:
             self._handle_actor_death(actor_id, w)
@@ -1642,6 +1679,16 @@ class HeadServer:
             self._fail_task_to_caller(spec, WorkerCrashedError(
                 f"worker pid={w.pid} died while running "
                 f"{spec.describe()} (exit code {w.returncode})"))
+
+    def _prune_spawned_locked(self):
+        """Bound the spawn ledger: reaped records are diagnostics only,
+        so once they exceed RAY_TPU_HEAD_SPAWNED_MAX the oldest go
+        (insertion order ~ spawn order). Live entries are never pruned —
+        lease release and death handling still need them."""
+        reaped = [t for t, w in self._spawned.items() if w._reaped]
+        if len(reaped) > self._spawned_max:
+            for t in reaped[:len(reaped) - self._spawned_max]:
+                del self._spawned[t]
 
     def _handle_node_death(self, node_id: str):
         """A node agent disconnected: declare its workers dead (reference:
@@ -1700,12 +1747,24 @@ class HeadServer:
 
     def _release_actor_name_locked(self, info: ActorInfo):
         """Free a named actor's name when it dies for good, so the name can
-        be reused (reference: named actor entries are cleaned on death)."""
+        be reused (reference: named actor entries are cleaned on death).
+
+        Called while holding the global lock; the shard compare-and-
+        delete takes that shard's KV lock — the one sanctioned
+        HeadServer._lock -> HeadShard._lock nesting (see
+        head_shards.py docstring + the lock-graph gate)."""
         name = info.spec.name
         if name:
             key = "named_actor:" + name
-            if self._kv.get(key) == info.spec.actor_id.binary():
-                del self._kv[key]
+            self._shards.kv_del_if_equals(
+                key, info.spec.actor_id.binary())
+        # Opportunistic bound on the DEAD-actor ledger: keep the most
+        # recent _dead_actors_max corpses for diagnostics, drop the rest
+        # (insertion order ~ creation order, so oldest go first).
+        dead = [a for a, i in self._actors.items() if i.state == DEAD]
+        if len(dead) > self._dead_actors_max:
+            for a in dead[:len(dead) - self._dead_actors_max]:
+                del self._actors[a]
 
     def _fail_task_to_caller(self, spec: TaskSpec, error: Exception):
         self._record_task(spec, task_events.FAILED, error=str(error)[:300])
@@ -1725,7 +1784,7 @@ class HeadServer:
         with self._lock:
             node = self._nodes["node0"]
             for _ in range(n):
-                self._spawn_worker(node, dedicated=False)
+                self._spawn_worker_locked(node, dedicated=False)
 
     def shutdown(self):
         with self._lock:

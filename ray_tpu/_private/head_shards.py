@@ -1,0 +1,424 @@
+"""Partitioned head tables: crc32-routed shard planes, one lock each.
+
+Parity: the reference GCS keeps its metadata in per-table storage
+shards behind independent mutexes (`src/ray/gcs/gcs_server/` table
+storage); this module gives the head the same shape. The hot tables
+that used to live under `HeadServer._lock` — the KV store, the object-
+location directory, per-process metric snapshots, and the task-
+lifecycle ring — move into ``RAY_TPU_HEAD_SHARDS`` independent
+``HeadShard`` planes. A key routes to ``crc32(key) % N`` (stable across
+processes — Python ``hash()`` is per-process salted and would break
+routing determinism), so two clients touching different keys contend
+on different locks instead of convoying behind one global RLock.
+
+Scheduler state (nodes, workers, leases, pending queue) stays under
+the head's residual global lock: a lease grant must view a node's
+whole resource vector atomically, so that plane cannot shard by key.
+
+Lock ordering: ``HeadServer._lock -> HeadShard._lock`` is the only
+permitted cross-class order (the named-actor plane takes a shard KV
+lock while holding the global lock). Shard code never calls back into
+the head, so the reverse edge cannot form; the graftcheck lock-graph
+gate (tests/test_graftcheck.py) asserts exactly that. Cross-shard
+reads (kv_keys, cluster metrics, task listings) take one shard lock
+at a time and merge per-shard snapshots — there is no global freeze,
+so a merged view is a consistent-per-shard, not point-in-time, cut.
+
+Contention instrumentation: every shard lock is a ``_TimedRLock`` —
+an uncontended acquire costs one extra ``acquire(blocking=False)``
+and touches no metrics; a contended acquire records its wait into the
+``head_lock_wait_s`` histogram and the shard's cumulative wait/held
+counters, from which the head's monitor loop derives the per-shard
+``head_shard_occupancy.s<k>`` gauges.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import zlib
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from . import config, metrics, task_events
+from .graftcheck import racecheck
+from .graftcheck.runtime_trace import make_rlock
+
+# Per-shard object-location pub/sub channels: the head publishes
+# location deltas for shard k on "objloc:k"; runtime clients subscribe
+# to all N and maintain a local directory cache (runtime.py).
+OBJLOC_CHANNEL_PREFIX = "objloc:"
+
+
+def objloc_channel(shard_index: int) -> str:
+    return f"{OBJLOC_CHANNEL_PREFIX}{shard_index}"
+
+
+def shard_key_bytes(key) -> bytes:
+    """Canonical routing bytes for any table key: str KV keys, bytes,
+    ObjectID/TaskID-style objects (via .binary()), process addrs."""
+    if isinstance(key, bytes):
+        return key
+    if isinstance(key, str):
+        return key.encode("utf-8", "surrogatepass")
+    binary = getattr(key, "binary", None)
+    if callable(binary):
+        return binary()
+    return repr(key).encode("utf-8", "surrogatepass")
+
+
+def shard_index(key, n: int) -> int:
+    """Stable key -> shard routing: crc32 mod N (NOT Python hash(),
+    which is salted per process — clients and head must agree)."""
+    if n <= 1:
+        return 0
+    return zlib.crc32(shard_key_bytes(key)) % n
+
+
+def default_shard_count() -> int:
+    return max(1, int(config.get("RAY_TPU_HEAD_SHARDS")))
+
+
+class _TimedRLock:
+    """Reentrant lock wrapper measuring contended waits + held time.
+
+    The fast path (lock free or already held by this thread) is one
+    non-blocking acquire — no clock reads for the wait side, no metrics
+    registry traffic, so an uncontended sharded head pays nearly
+    nothing for the instrumentation. Only a contended acquire times the
+    wait and lands one ``head_lock_wait_s`` sample. Held time is
+    accounted per outermost acquire/release pair (thread-local depth
+    handles reentrancy); all stats fields are mutated while the lock is
+    held, so they need no synchronization of their own.
+
+    Wraps the runtime_trace factory product, so under RAY_TPU_RACECHECK
+    / RAY_TPU_LOCKCHECK the inner lock is a TracedRLock and the race /
+    lock-order planes see every shard acquisition as usual.
+    """
+
+    def __init__(self, inner, stats: "HeadShard"):
+        self._inner = inner
+        self._stats = stats
+        self._depth = threading.local()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if blocking and timeout == -1:
+            if not self._inner.acquire(blocking=False):
+                t0 = time.perf_counter()
+                self._inner.acquire()
+                wait = time.perf_counter() - t0
+                metrics.observe("head_lock_wait_s", wait)
+                # Under the lock now: plain field updates are safe.
+                self._stats.lock_wait_s += wait
+                self._stats.contended_acquires += 1
+        else:
+            if not self._inner.acquire(blocking, timeout):
+                return False
+        d = self._depth
+        n = getattr(d, "n", 0)
+        d.n = n + 1
+        if n == 0:
+            d.t0 = time.perf_counter()
+        return True
+
+    def release(self):
+        d = self._depth
+        n = getattr(d, "n", 1)
+        d.n = n - 1
+        if n == 1:
+            # Still holding: the held-time accumulation is protected.
+            self._stats.lock_held_s += time.perf_counter() - d.t0
+        self._inner.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+
+class HeadShard:
+    """One shard plane: KV range + object-location range + metric
+    snapshots + task-ring segment, all behind this shard's lock."""
+
+    def __init__(self, index: int, obj_locations_max: int,
+                 task_log_max: int):
+        self.index = index
+        # Stats fields (mutated only while the lock is held).
+        self.lock_wait_s = 0.0
+        self.lock_held_s = 0.0
+        self.contended_acquires = 0
+        self._lock = _TimedRLock(make_rlock("HeadShard._lock"), self)
+        self._kv: Dict[str, bytes] = racecheck.traced_shared(
+            {}, "HeadShard._kv")
+        # oid -> {process addr: node_id}, bounded LRU (the directory
+        # cap splits across shards). `_grants` orders replica handouts
+        # least-loaded first, as the unsharded directory did.
+        self._obj_locations: "OrderedDict[object, Dict[str, str]]" = \
+            racecheck.traced_shared(
+                OrderedDict(), "HeadShard._obj_locations")
+        self._obj_location_grants: Dict[str, int] = \
+            racecheck.traced_shared(
+                {}, "HeadShard._obj_location_grants")
+        self._obj_locations_max = max(1, obj_locations_max)
+        # addr -> {"node":, "counters":, "gauges":, ...} pushes, plus
+        # dead-process counter folds per node (counters are cluster-
+        # lifetime totals and must survive their process).
+        self._metric_snaps: Dict[str, dict] = racecheck.traced_shared(
+            {}, "HeadShard._metric_snaps")
+        self._dead_counters: Dict[str, Dict[str, float]] = \
+            racecheck.traced_shared({}, "HeadShard._dead_counters")
+        # Task-ring segment (task_events.TaskStateLog carries its own
+        # lock; routing by task id keeps one task's transitions on one
+        # segment so state-rank ordering still applies per record).
+        self.task_log = task_events.TaskStateLog(task_log_max)
+
+    # -- kv ------------------------------------------------------------
+    def kv_put(self, key: str, value,
+               overwrite: bool = True) -> Tuple[bool, bool]:
+        """Returns (stored, existed)."""
+        with self._lock:
+            existed = key in self._kv
+            stored = not (overwrite is False and existed)
+            if stored:
+                self._kv[key] = value
+            return stored, existed
+
+    def kv_get(self, key: str):
+        with self._lock:
+            return self._kv.get(key)
+
+    def kv_del(self, key: str) -> None:
+        with self._lock:
+            self._kv.pop(key, None)
+
+    def kv_put_if_absent(self, key: str, value) -> bool:
+        """Atomic claim — the named-actor registration primitive."""
+        with self._lock:
+            if key in self._kv:
+                return False
+            self._kv[key] = value
+            return True
+
+    def kv_del_if_equals(self, key: str, value) -> bool:
+        """Atomic compare-and-delete — named-actor name release (only
+        the incarnation that owns the name may free it)."""
+        with self._lock:
+            if self._kv.get(key) == value:
+                del self._kv[key]
+                return True
+            return False
+
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        with self._lock:
+            return [k for k in self._kv if k.startswith(prefix)]
+
+    # -- object locations ----------------------------------------------
+    def location_add(self, oid, addr: str, node_id: str) -> bool:
+        """Register a sealed copy; True when this (oid, addr) pair is
+        new (i.e. worth publishing a delta)."""
+        with self._lock:
+            entry = self._obj_locations.get(oid)
+            if entry is None:
+                entry = self._obj_locations[oid] = {}
+                while len(self._obj_locations) > self._obj_locations_max:
+                    self._obj_locations.popitem(last=False)
+            fresh = addr not in entry
+            entry[addr] = node_id
+            return fresh
+
+    def location_remove(self, oid, addr: str) -> bool:
+        """Deregister a copy; True when something was removed."""
+        with self._lock:
+            entry = self._obj_locations.get(oid)
+            if entry is None:
+                return False
+            removed = entry.pop(addr, None) is not None
+            if removed and not entry:
+                del self._obj_locations[oid]
+            return removed
+
+    def locations(self, oid) -> List[Tuple[str, str]]:
+        """(addr, node) replicas, least-granted first; bumps the grant
+        count of the predicted pick so borrowers spread over copies."""
+        with self._lock:
+            entry = self._obj_locations.get(oid) or {}
+            locs = sorted(
+                entry.items(),
+                key=lambda kv: self._obj_location_grants.get(kv[0], 0))
+            if locs:
+                first = locs[0][0]
+                self._obj_location_grants[first] = \
+                    self._obj_location_grants.get(first, 0) + 1
+            return locs
+
+    def location_drop_addr(self, addr: str) -> int:
+        """A process died: drop every replica it registered (this
+        shard's range). Returns the number of entries dropped."""
+        dropped = 0
+        with self._lock:
+            for oid in list(self._obj_locations):
+                entry = self._obj_locations[oid]
+                if entry.pop(addr, None) is not None:
+                    dropped += 1
+                    if not entry:
+                        del self._obj_locations[oid]
+            self._obj_location_grants.pop(addr, None)
+        return dropped
+
+    def location_counts(self) -> List[Tuple[str, int]]:
+        with self._lock:
+            return [(oid.hex() if hasattr(oid, "hex") else str(oid),
+                     len(entry))
+                    for oid, entry in self._obj_locations.items()]
+
+    # -- metric snapshots ----------------------------------------------
+    def metrics_push(self, addr: str, snap: dict) -> None:
+        with self._lock:
+            self._metric_snaps[addr] = snap
+
+    def fold_dead(self, addr: str) -> None:
+        """Conn closed: fold the process's counters into its node's
+        dead-counter total (gauges die with the process)."""
+        with self._lock:
+            snap = self._metric_snaps.pop(addr, None)
+            if snap is not None:
+                dead = self._dead_counters.setdefault(
+                    snap.get("node") or "node0", {})
+                for k, v in (snap.get("counters") or {}).items():
+                    dead[k] = dead.get(k, 0.0) + v
+
+    def metrics_snapshot(self) -> Tuple[Dict[str, dict],
+                                        Dict[str, Dict[str, float]]]:
+        """Copies of (live snaps, dead counter folds) — ~1/N of the
+        cluster each, so cross-shard aggregation copies small pieces
+        instead of one whole table under one lock."""
+        with self._lock:
+            return (dict(self._metric_snaps),
+                    {node: dict(d)
+                     for node, d in self._dead_counters.items()})
+
+    # -- introspection ---------------------------------------------------
+    def stats(self) -> dict:
+        """Table sizes + lock contention counters for the monitor loop,
+        `debug_dump_data()` and the saturation bench."""
+        task_records = sum(self.task_log.state_counts().values())
+        with self._lock:
+            return {
+                "shard": self.index,
+                "kv_keys": len(self._kv),
+                "obj_locations": len(self._obj_locations),
+                "metric_snaps": len(self._metric_snaps),
+                "task_records": task_records,
+                "lock_wait_s": self.lock_wait_s,
+                "lock_held_s": self.lock_held_s,
+                "contended_acquires": self.contended_acquires,
+            }
+
+
+def _plane_kv_del_if_equals(plane: "HeadShard", key: str, value) -> bool:
+    """Annotated indirection for the one op the head invokes while
+    holding its global lock (named-actor name release): the static
+    lock graph resolves the parameter type, so the HeadServer._lock ->
+    HeadShard._lock edge is visible to the GC201 cycle gate."""
+    with plane._lock:
+        if plane._kv.get(key) == value:
+            del plane._kv[key]
+            return True
+        return False
+
+
+class HeadShards:
+    """N shard planes + crc32 routing + merged cross-shard reads."""
+
+    def __init__(self, nshards: Optional[int] = None,
+                 obj_locations_max: int = 4096,
+                 task_log_max: Optional[int] = None):
+        if nshards is None:
+            nshards = default_shard_count()
+        self.nshards = max(1, int(nshards))
+        if task_log_max is None:
+            task_log_max = config.get("RAY_TPU_TASK_LOG_MAX")
+        per_dir = -(-int(obj_locations_max) // self.nshards)  # ceil
+        per_ring = max(16, int(task_log_max) // self.nshards)
+        self.planes: List[HeadShard] = [
+            HeadShard(i, per_dir, per_ring) for i in range(self.nshards)]
+
+    def shard_index(self, key) -> int:
+        return shard_index(key, self.nshards)
+
+    def shard_for(self, key) -> HeadShard:
+        return self.planes[shard_index(key, self.nshards)]
+
+    # -- cross-shard merges (one shard lock at a time, no freeze) ------
+    def kv_keys(self, prefix: str = "") -> List[str]:
+        out: List[str] = []
+        for plane in self.planes:
+            out.extend(plane.kv_keys(prefix))
+        return out
+
+    def kv_del_if_equals(self, key: str, value) -> bool:
+        return _plane_kv_del_if_equals(self.shard_for(key), key, value)
+
+    def location_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for plane in self.planes:
+            out.update(plane.location_counts())
+        return out
+
+    def drop_addr(self, addr: str) -> int:
+        total = 0
+        for plane in self.planes:
+            total += plane.location_drop_addr(addr)
+        return total
+
+    def metrics_merged(self) -> Tuple[Dict[str, dict],
+                                      Dict[str, Dict[str, float]]]:
+        snaps: Dict[str, dict] = {}
+        dead: Dict[str, Dict[str, float]] = {}
+        for plane in self.planes:
+            psnaps, pdead = plane.metrics_snapshot()
+            snaps.update(psnaps)
+            for node, counters in pdead.items():
+                acc = dead.setdefault(node, {})
+                for k, v in counters.items():
+                    acc[k] = acc.get(k, 0.0) + v
+        return snaps, dead
+
+    # -- task ring segments --------------------------------------------
+    def apply_task_event(self, ev: dict) -> None:
+        tid = ev.get("task_id")
+        if not tid:
+            return
+        self.shard_for(tid).task_log.apply(ev)
+
+    def task_list(self, state: Optional[str] = None,
+                  name: Optional[str] = None,
+                  limit: int = 100) -> List[dict]:
+        merged: List[dict] = []
+        for plane in self.planes:
+            merged.extend(plane.task_log.list(
+                state=state, name=name, limit=limit))
+        merged.sort(key=lambda r: r.get("start") or 0.0, reverse=True)
+        return merged[:limit] if limit else merged
+
+    def task_summary(self) -> Dict[str, Dict[str, int]]:
+        out: Dict[str, Dict[str, int]] = {}
+        for plane in self.planes:
+            for name, per in plane.task_log.summary().items():
+                acc = out.setdefault(name, {})
+                for state, n in per.items():
+                    acc[state] = acc.get(state, 0) + n
+        return out
+
+    def task_state_counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for plane in self.planes:
+            for state, n in plane.task_log.state_counts().items():
+                out[state] = out.get(state, 0) + n
+        return out
+
+    def stats(self) -> List[dict]:
+        return [plane.stats() for plane in self.planes]

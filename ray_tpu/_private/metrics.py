@@ -98,6 +98,18 @@ change, including chaos preemptions), and histogram `actor_recovery_s`
 (evict/death to the replacement's first harvested sample — the
 recovery-latency distribution `scripts fleet`, `scripts stat
 --metrics`, debug_dump and the bench snapshot report).
+
+Head-shard-plane series (_private/head_shards.py + the partitioned
+control plane): histogram `head_lock_wait_s` (wait time of every
+CONTENDED head-shard lock acquire — uncontended acquires record
+nothing, so the histogram directly measures convoying; the saturation
+bench reports its tails before/after sharding); mean-rollup gauges
+`head_shard_occupancy.s<k>` (per-shard lock duty cycle over the
+monitor loop's ~2s windows) plus `head_shard_kv.s<k>` /
+`head_shard_locations.s<k>` table sizes; client-side directory-cache
+counters `object_dir_lookups` / `object_dir_cache_hits` /
+`object_dir_rpcs` (steady-state routed fetches must show lookups
+growing while rpcs stay flat — the zero-RPC acceptance gate).
 """
 
 from __future__ import annotations

@@ -23,7 +23,18 @@ reference ObjectDirectory's location pub/sub, `object_directory.h`):
   (fire-and-forget; stale entries are tolerated, fetch falls back to
   the owner on a miss).
 - ``object_locations`` — request/reply resolving an object's replica
-  set, least-loaded first.
+  set, least-loaded first. With the sharded head this is the cache-miss
+  path only: clients keep a local directory cache (runtime.py) that the
+  pub/sub deltas below maintain, so steady-state routed fetches issue
+  zero head RPCs.
+- ``head_shard_info`` — request/reply returning the head's shard count
+  N; the client subscribes to the ``objloc:<k>`` channel for every
+  ``k in [0, N)`` before its first directory RPC.
+- ``objloc:<k>`` publishes (head -> subscribed clients) — directory
+  deltas for shard k: ``{"op": "add", "object_id", "addr", "node"}``
+  on a fresh registration, ``{"op": "remove", "object_id", "addr"}``
+  on eviction, and ``{"op": "drop_addr", "addr"}`` when a process
+  disconnects (clients scrub every cached entry naming the address).
 - ``get_object`` may now carry ``no_redirect`` (force the owner to
   serve) and be answered with ``status="redirect"`` + ``addr``/``node``
   when the owner is at its ``RAY_TPU_MAX_UPLOADS_PER_OBJECT`` fan-out

@@ -35,7 +35,7 @@ import cloudpickle
 
 from ..exceptions import (ActorDiedError, GetTimeoutError, ObjectLostError,
                           TaskError, WorkerCrashedError)
-from . import chaos, config
+from . import chaos, config, head_shards
 from . import object_ref as object_ref_mod
 from . import protocol, serialization, task_events
 from .backoff import Backoff
@@ -976,6 +976,32 @@ class Runtime:
         self._object_sent_to: Dict[ObjectID, list] = {}
         self.shm.on_seal = self._on_store_seal
         self.shm.on_evict = self._on_store_evict
+        # Client-side object-location directory cache (head-sharding
+        # plane): location lookups land here and the head's per-shard
+        # `objloc:<k>` pub/sub deltas keep it fresh — add on seal,
+        # remove on evict, drop_addr on process death — so the steady-
+        # state routed-fetch path resolves replicas with ZERO head
+        # RPCs (counters: object_dir_lookups / object_dir_cache_hits /
+        # object_dir_rpcs). Bounded LRU; negative results are cached
+        # too (the add delta fills them in when a replica appears).
+        # Staleness is safe: a wrong pick falls back to the owner and
+        # lands in _bad_sources exactly like a stale head reply did.
+        from collections import OrderedDict as _OD_dir
+        self._dir_cache_enabled = bool(config.get("RAY_TPU_DIR_CACHE"))
+        self._dir_cache_max = max(8, int(config.get(
+            "RAY_TPU_DIR_CACHE_MAX")))
+        self._dir_lock = make_lock("Runtime._dir_lock")
+        self._dir_cache: "_OD_dir[ObjectID, Dict[str, str]]" = \
+            racecheck.traced_shared(_OD_dir(), "Runtime._dir_cache")
+        # Local replica-handout rotation (the unsharded head rotated
+        # globally; client-local rotation needs no head round-trip).
+        self._dir_grants: Dict[str, int] = {}
+        # objloc subscription state: set up once, lazily, BEFORE the
+        # first directory RPC so no delta can slip between the
+        # snapshot and the subscription.
+        self._dir_sub_lock = make_lock("Runtime._dir_sub_lock")
+        self._dir_subscribed = False
+        self._dir_shards = 0
 
         # Worker leases (reference: `direct_task_transport.h:36,68,89`):
         # once a lease is granted, normal tasks of that resource shape go
@@ -1798,29 +1824,139 @@ class Runtime:
         return status
 
     def _pick_fetch_source(self, ref: ObjectRef) -> Optional[str]:
-        """Resolve `ref`'s replica set from the head directory and pick
-        the best non-local source, or None to go straight to the owner.
-        Same-node entries are skipped — the local probe already covers
-        them with a direct mmap."""
+        """Resolve `ref`'s replica set — from the local directory cache
+        when it holds the object, falling back to one head RPC on a
+        miss — and pick the best non-local source, or None to go
+        straight to the owner. Same-node entries are skipped — the
+        local probe already covers them with a direct mmap."""
         if not self._routed_fetch_eligible(ref):
             return None
-        try:
-            reply = self.head.request(
-                {"kind": "object_locations", "object_id": ref.id},
-                timeout=5)
-        except Exception:
+        locs = self._dir_locations(ref.id)
+        if locs is None:
             return None  # directory unavailable: owner path
         with self._replica_lock:
             bad = set(self._bad_sources.get(ref.id, ()))
-        for loc in reply.get("locations") or ():
-            addr = loc.get("addr")
+        for addr, node in locs:
             if not addr or addr == self.addr \
                     or addr == ref.owner_addr or addr in bad:
                 continue
-            if loc.get("node") == self.node_id:
+            if node == self.node_id:
                 continue
-            return addr  # head orders least-loaded first
+            return addr  # ordered least-granted first
         return None
+
+    def _dir_locations(self, oid: ObjectID) -> Optional[list]:
+        """(addr, node) replicas of `oid`, least-granted first, or None
+        when the directory is unreachable. With the cache enabled
+        (RAY_TPU_DIR_CACHE) a hit costs zero head RPCs; a miss issues
+        one `object_locations` RPC and caches the reply — including an
+        empty one — after which the `objloc:<k>` deltas keep the entry
+        fresh."""
+        from . import metrics as metrics_mod
+        metrics_mod.inc("object_dir_lookups")
+        if not self._dir_cache_enabled:
+            reply = self._dir_rpc(oid)
+            if reply is None:
+                return None
+            return [(loc.get("addr"), loc.get("node"))
+                    for loc in reply.get("locations") or ()]
+        self._dir_subscribe_once()
+        with self._dir_lock:
+            entry = self._dir_cache.get(oid)
+            if entry is not None:
+                self._dir_cache.move_to_end(oid)
+                metrics_mod.inc("object_dir_cache_hits")
+                return self._dir_rank_locked(entry)
+        # Miss: one snapshot RPC (outside _dir_lock — the reply is
+        # dispatched by the same recv loop that delivers publishes,
+        # which needs _dir_lock; holding it here would deadlock).
+        reply = self._dir_rpc(oid)
+        if reply is None:
+            return None
+        fetched = {loc.get("addr"): loc.get("node") or ""
+                   for loc in reply.get("locations") or ()
+                   if loc.get("addr")}
+        with self._dir_lock:
+            cur = self._dir_cache.get(oid)
+            if cur is None:
+                self._dir_cache[oid] = cur = fetched
+                while len(self._dir_cache) > self._dir_cache_max:
+                    self._dir_cache.popitem(last=False)
+            else:
+                # Deltas raced the snapshot and already built the
+                # entry; the fresher delta state wins — only backfill.
+                for a, nd in fetched.items():
+                    cur.setdefault(a, nd)
+            return self._dir_rank_locked(cur)
+
+    def _dir_rpc(self, oid: ObjectID) -> Optional[dict]:
+        from . import metrics as metrics_mod
+        metrics_mod.inc("object_dir_rpcs")
+        try:
+            return self.head.request(
+                {"kind": "object_locations", "object_id": oid},
+                timeout=5)
+        except Exception:
+            return None
+
+    def _dir_rank_locked(self, entry: Dict[str, str]) -> list:
+        """Order replicas least-granted first and bump the predicted
+        pick — the client-local analog of the head's grant rotation, so
+        borrowers spread over copies without a head round-trip."""
+        locs = sorted(entry.items(),
+                      key=lambda kv: self._dir_grants.get(kv[0], 0))
+        if locs:
+            first = locs[0][0]
+            if len(self._dir_grants) > 1024:  # leak bound
+                self._dir_grants.clear()
+            self._dir_grants[first] = self._dir_grants.get(first, 0) + 1
+        return locs
+
+    def _dir_subscribe_once(self):
+        """First directory use: learn the shard count and subscribe to
+        every `objloc:<k>` channel BEFORE the first snapshot RPC. The
+        head processes one connection's messages in order, so no delta
+        published after the snapshot can be missed."""
+        if self._dir_subscribed:
+            return
+        with self._dir_sub_lock:
+            if self._dir_subscribed:
+                return
+            try:
+                reply = self.head.request(
+                    {"kind": "head_shard_info"}, timeout=5)
+                n = max(1, int(reply.get("shards") or 1))
+                for k in range(n):
+                    self.head.send({
+                        "kind": "subscribe",
+                        "channel": head_shards.objloc_channel(k)})
+                self._dir_shards = n
+            except Exception:
+                # Old head / unreachable: stay on the RPC-per-lookup
+                # path rather than serving a cache nothing invalidates.
+                self._dir_cache_enabled = False
+            self._dir_subscribed = True
+
+    def _on_objloc_delta(self, data: dict):
+        """Apply one published directory delta to the local cache.
+        Deltas for uncached objects are dropped (except drop_addr,
+        which scrubs everything) — the first lookup snapshots the full
+        replica set anyway."""
+        op = data.get("op")
+        with self._dir_lock:
+            if op == "add":
+                entry = self._dir_cache.get(data.get("object_id"))
+                if entry is not None:
+                    entry[data["addr"]] = data.get("node") or ""
+            elif op == "remove":
+                entry = self._dir_cache.get(data.get("object_id"))
+                if entry is not None:
+                    entry.pop(data.get("addr"), None)
+            elif op == "drop_addr":
+                addr = data.get("addr")
+                for entry in self._dir_cache.values():
+                    entry.pop(addr, None)
+                self._dir_grants.pop(addr, None)
 
     def _note_bad_source(self, oid: ObjectID, addr: Optional[str]):
         if not addr:
@@ -3216,6 +3352,8 @@ class Runtime:
             ev = self._actor_events.get(aid)
             if ev is not None:
                 ev.set()
+        elif channel.startswith(head_shards.OBJLOC_CHANNEL_PREFIX):
+            self._on_objloc_delta(msg["data"])
         elif channel == "error":
             data = msg["data"]
             print(f"[ray_tpu] remote error: {data}", flush=True)

@@ -339,6 +339,302 @@ def weight_sync_benchmarks(quick: bool = False, borrowers: int = 4,
     return results
 
 
+def head_saturation_benchmarks(quick: bool = False, arms=None,
+                               e2e: bool = True):
+    """Head control-plane saturation vs shard operating point (PERF.md
+    round 11).
+
+    Boots a raw in-process HeadServer per arm — no workers, no object
+    store, just the control plane — and hammers it from N client
+    threads, each on its OWN connection (so handler threads really
+    contend), with the hot-path op mix: KV put/get, object-location
+    add/lookup, task-event transitions, metrics pushes.
+
+    Arms are (shards, pubsub) operating points. The baseline arm
+    (1, False) is the PRE-SHARDING control plane: one table plane, one
+    lock, and a request/response directory — every location lookup is
+    a head RPC, which is exactly what the unsharded head charged for
+    each routed fetch. Sharded arms subscribe to the per-shard
+    `objloc:<k>` delta channels and keep a local directory cache (the
+    same protocol runtime.py's client cache speaks), so steady-state
+    lookups cost no head RPC at all — directory reads scale off the
+    head entirely, and the head's cycles go to task/lease/KV traffic
+    instead.
+
+    Throughput counting is exact, not send-rate: fire-and-forget ops
+    are counted as *processed* because each client ends its window with
+    a round-trip on the same connection — per-connection in-order
+    handling means that reply proves every prior send was applied. The
+    window closes at the last drain reply, so a backlogged head pays
+    for its backlog in the denominator.
+
+    Reports per arm: head_tasks_per_s (task-event transitions applied),
+    head_dir_ops_per_s (location adds + lookups served, local or RPC),
+    dir RPC/hit split, total ops/s, and the `head_lock_wait_s`
+    contended-acquire tail from the head's own registry. With `e2e`,
+    also runs a real-runtime task burst per arm and reports end-to-end
+    tasks/s plus the `task_queue_wait_s` tail (the before/after
+    quantities the ISSUE's table tracks)."""
+    import hashlib
+    import shutil
+    import tempfile
+    import threading
+
+    from ray_tpu._private import config as config_mod
+    from ray_tpu._private import head as head_mod
+    from ray_tpu._private import metrics as metrics_mod
+    from ray_tpu._private import protocol
+
+    if arms is None:
+        arms = ((1, False), (4, True)) if quick \
+            else ((1, False), (2, True), (4, True))
+    nclients = 4 if quick else 8
+    window = 1.0 if quick else 3.0
+    results = {}
+
+    def one_arm(nshards: int, pubsub: bool) -> dict:
+        config_mod.set_override("RAY_TPU_HEAD_SHARDS", nshards)
+        metrics_mod.reset()
+        session_dir = tempfile.mkdtemp(prefix="ray_tpu_headsat_")
+        head = head_mod.HeadServer(session_dir, "headsat", {"CPU": 1.0})
+        stop = threading.Event()
+        barrier = threading.Barrier(nclients + 1)
+        # Per-thread [task transitions, dir ops, total, rpcs, hits].
+        counts = [[0, 0, 0, 0, 0] for _ in range(nclients)]
+        ends = [0.0] * nclients
+        errors: list = []
+
+        def worker(t: int):
+            # Local directory cache, fed by the per-shard objloc
+            # delta channels — the same pub/sub contract runtime.py's
+            # client cache consumes.
+            cache: dict = {}
+            cache_lock = threading.Lock()
+
+            def on_msg(c, m):
+                if m.get("kind") != "publish":
+                    return
+                if not str(m.get("channel", "")).startswith("objloc:"):
+                    return
+                d = m.get("data") or {}
+                op = d.get("op")
+                with cache_lock:
+                    if op == "add":
+                        cache.setdefault(d.get("object_id"), {})[
+                            d["addr"]] = d.get("node") or ""
+                    elif op == "remove":
+                        e = cache.get(d.get("object_id"))
+                        if e is not None:
+                            e.pop(d.get("addr"), None)
+                    elif op == "drop_addr":
+                        for e in cache.values():
+                            e.pop(d.get("addr"), None)
+
+            conn = protocol.connect(head.sock_path, f"sat-{t}", on_msg,
+                                    hello_extra={"role": "probe"})
+            try:
+                if pubsub:
+                    info = conn.request({"kind": "head_shard_info"},
+                                        timeout=30)
+                    for k in range(int(info.get("shards") or 1)):
+                        # Subscribed BEFORE any add: per-conn ordering
+                        # means no delta for our own adds is missed.
+                        conn.send({"kind": "subscribe",
+                                   "channel": f"objloc:{k}"})
+                oids = [hashlib.sha1(f"sat:{t}:{i}".encode()).digest()
+                        for i in range(16)]
+                payload = b"x" * 64
+                j = 0
+                barrier.wait(timeout=30)
+                while not stop.is_set():
+                    k = j % 16
+                    if k == 0:
+                        conn.request({"kind": "kv_put",
+                                      "key": f"sat:{t}:{j % 32}",
+                                      "value": payload}, timeout=30)
+                    elif k == 1:
+                        conn.request({"kind": "kv_get",
+                                      "key": f"sat:{t}:{j % 32}"},
+                                     timeout=30)
+                    elif k in (2, 10):
+                        conn.send({"kind": "object_location_add",
+                                   "object_id": oids[j % 16],
+                                   "addr": f"sat-{t}",
+                                   "node_id": f"n{t}"})
+                        counts[t][1] += 1
+                    elif k in (3, 4, 5, 6, 7, 8, 9, 11):
+                        oid = oids[j % 16]
+                        hit = False
+                        if pubsub:
+                            with cache_lock:
+                                hit = oid in cache
+                        if hit:
+                            counts[t][4] += 1
+                        else:
+                            r = conn.request(
+                                {"kind": "object_locations",
+                                 "object_id": oid}, timeout=30)
+                            counts[t][3] += 1
+                            if pubsub:
+                                with cache_lock:
+                                    cache.setdefault(oid, {}).update(
+                                        {loc["addr"]: loc["node"]
+                                         for loc in
+                                         r.get("locations") or ()})
+                        counts[t][1] += 1
+                    elif k in (12, 13, 14):
+                        tid = hashlib.sha1(
+                            f"sat:{t}:task:{j}".encode()).digest()[
+                                :16].hex()
+                        base = time.time()
+                        conn.send({"kind": "task_events", "events": [
+                            {"task_id": tid, "state": "QUEUED",
+                             "ts": base, "name": f"sat-{t}"},
+                            {"task_id": tid, "state": "RUNNING",
+                             "ts": base},
+                            {"task_id": tid, "state": "FINISHED",
+                             "ts": base}]})
+                        counts[t][0] += 3
+                    else:
+                        conn.send({"kind": "metrics_push",
+                                   "node": f"n{t}",
+                                   "counters": {"sat_ops": float(j)}})
+                    counts[t][2] += 1
+                    j += 1
+                    if j % 64 == 0:
+                        # Periodic round-trip bounds the send backlog
+                        # (the real runtime's RPCs do the same).
+                        conn.request({"kind": "kv_get",
+                                      "key": f"sat:{t}:0"}, timeout=30)
+                # Drain barrier: this round-trip proves every prior
+                # send on this connection has been handled.
+                conn.request({"kind": "kv_get", "key": f"sat:{t}:0"},
+                             timeout=30)
+            except Exception as e:  # noqa: BLE001 - surface below
+                errors.append(e)
+            finally:
+                ends[t] = time.perf_counter()
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+
+        threads = [threading.Thread(target=worker, args=(t,),
+                                    name=f"headsat-{t}")
+                   for t in range(nclients)]
+        try:
+            for th in threads:
+                th.start()
+            barrier.wait(timeout=30)
+            t0 = time.perf_counter()
+            time.sleep(window)
+            stop.set()
+            for th in threads:
+                th.join(timeout=60)
+            if errors:
+                raise errors[0]
+            elapsed = max(ends) - t0
+            snap = metrics_mod.snapshot()
+        finally:
+            head.shutdown()
+            shutil.rmtree(session_dir, ignore_errors=True)
+            config_mod.clear_override("RAY_TPU_HEAD_SHARDS")
+        arm = {
+            "tasks_per_s": sum(c[0] for c in counts) / elapsed,
+            "dir_ops_per_s": sum(c[1] for c in counts) / elapsed,
+            "ops_per_s": sum(c[2] for c in counts) / elapsed,
+            "dir_rpcs": float(sum(c[3] for c in counts)),
+            "dir_cache_hits": float(sum(c[4] for c in counts)),
+        }
+        h = snap["hists"].get("head_lock_wait_s")
+        if h:
+            s = metrics_mod.hist_summary(h)
+            arm["lock_wait_count"] = s["count"]
+            arm["lock_wait_p50_ms"] = 1e3 * (s["p50"] or 0.0)
+            arm["lock_wait_p99_ms"] = 1e3 * (s["p99"] or 0.0)
+        else:
+            arm["lock_wait_count"] = 0.0
+        return arm
+
+    def e2e_arm(nshards: int, pubsub: bool) -> dict:
+        """Real-runtime task burst at the arm's operating point: e2e
+        tasks/s plus the task_queue_wait_s tail, which lands in the
+        (in-process) head's registry as tasks turn terminal."""
+        config_mod.set_override("RAY_TPU_HEAD_SHARDS", nshards)
+        config_mod.set_override("RAY_TPU_DIR_CACHE",
+                                "1" if pubsub else "0")
+        metrics_mod.reset()
+        import ray_tpu as rt
+        h = None
+        rt.init(num_cpus=4)
+        try:
+            @rt.remote
+            def _noop():
+                return 0
+
+            n = 200 if quick else 600
+            t0 = time.perf_counter()
+            rt.get([_noop.remote() for _ in range(n)])
+            e2e_rate = n / (time.perf_counter() - t0)
+            # Worker task-event buffers flush on a 0.5 s cadence;
+            # terminal transitions observe the histogram at the head.
+            deadline = time.time() + 10
+            while time.time() < deadline:
+                snap = metrics_mod.snapshot()
+                h = snap["hists"].get("task_queue_wait_s")
+                if h and (h.get("count") or 0) >= n * 0.9:
+                    break
+                time.sleep(0.25)
+        finally:
+            rt.shutdown()
+            config_mod.clear_override("RAY_TPU_HEAD_SHARDS")
+            config_mod.clear_override("RAY_TPU_DIR_CACHE")
+        out = {"e2e_tasks_per_s": e2e_rate}
+        if h:
+            s = metrics_mod.hist_summary(h)
+            out.update({"queue_wait_count": s["count"],
+                        "queue_wait_p50_ms": 1e3 * (s["p50"] or 0.0),
+                        "queue_wait_p99_ms": 1e3 * (s["p99"] or 0.0)})
+        return out
+
+    def tag(nshards, pubsub):
+        return f"s{nshards}" + ("" if pubsub else "_base")
+
+    for nshards, pubsub in arms:
+        arm = one_arm(nshards, pubsub)
+        if e2e:
+            arm.update(e2e_arm(nshards, pubsub))
+        label = f"shards={nshards} " + \
+            ("pubsub dir" if pubsub else "request/response dir")
+        lw = (f"lock-wait p50/p99 {arm['lock_wait_p50_ms']:.2f}/"
+              f"{arm['lock_wait_p99_ms']:.2f} ms "
+              f"({arm['lock_wait_count']:.0f} contended)"
+              if arm.get("lock_wait_count") else "lock-wait: uncontended")
+        print(f"head saturation [{label}] "
+              f"{arm['tasks_per_s']:>8.0f} tasks/s  "
+              f"{arm['dir_ops_per_s']:>8.0f} dir ops/s "
+              f"({arm['dir_rpcs']:.0f} rpc / "
+              f"{arm['dir_cache_hits']:.0f} cached)  "
+              f"{arm['ops_per_s']:>8.0f} total ops/s  {lw}")
+        if "queue_wait_p99_ms" in arm:
+            print(f"    e2e {arm['e2e_tasks_per_s']:.0f} tasks/s, "
+                  f"task_queue_wait p50/p99 "
+                  f"{arm['queue_wait_p50_ms']:.1f}/"
+                  f"{arm['queue_wait_p99_ms']:.1f} ms "
+                  f"({arm['queue_wait_count']:.0f} tasks)")
+        for k, v in arm.items():
+            results[f"headsat_{tag(nshards, pubsub)}_{k}"] = v
+    base = tag(*arms[0])
+    top = tag(*arms[-1])
+    if base != top:
+        for metric in ("tasks_per_s", "dir_ops_per_s"):
+            ratio = (results[f"headsat_{top}_{metric}"]
+                     / max(1e-9, results[f"headsat_{base}_{metric}"]))
+            results[f"headsat_{metric}_scaling"] = ratio
+            print(f"scaling {metric} [{top} vs {base}]: {ratio:.2f}x")
+    return results
+
+
 def weight_sync_ab(quick: bool = False, cycles: int = 3):
     """Interleaved A/B: the three arms alternate cluster boots (the
     PERF.md variance protocol — medians pool across cycles)."""
@@ -374,8 +670,17 @@ if __name__ == "__main__":
                         help="run only the weight-sync codec A/B "
                              "(full vs q8_delta vs sharded+delta, "
                              "interleaved)")
+    parser.add_argument("--head-saturation", action="store_true",
+                        dest="head_saturation",
+                        help="run only the head control-plane "
+                             "saturation sweep: tasks/s and directory "
+                             "ops/s vs RAY_TPU_HEAD_SHARDS, with "
+                             "head_lock_wait_s / task_queue_wait_s "
+                             "tails")
     args = parser.parse_args()
-    if args.weight_sync:
+    if args.head_saturation:
+        head_saturation_benchmarks(quick=args.quick)
+    elif args.weight_sync:
         weight_sync_ab(quick=args.quick)
     elif args.broadcast:
         broadcast_ab(quick=args.quick)

@@ -701,7 +701,7 @@ def cmd_check(args):
         args.paths, baseline_path=args.baseline,
         write_baseline=args.write_baseline, as_json=args.json,
         lockgraph=not args.no_lockgraph, race=args.race,
-        stress_seed=args.stress))
+        stress_seed=args.stress, head_stress_seed=args.head_stress))
 
 
 def main(argv=None):
@@ -721,6 +721,11 @@ def main(argv=None):
     p.add_argument("--stress", type=int, default=None, metavar="SEED",
                    help="race-stress seed (implies --race); verifies "
                         "byte-identical replay")
+    p.add_argument("--head-stress", type=int, default=None,
+                   metavar="SEED", dest="head_stress",
+                   help="race the sharded head: cross-shard kv/"
+                        "location/lease/task-event interleavings "
+                        "with racecheck armed + replay gate")
     p.set_defaults(fn=cmd_check)
 
     p = sub.add_parser(

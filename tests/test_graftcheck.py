@@ -130,6 +130,23 @@ def test_lock_graph_private_no_cycles():
     assert len(graph.edges) >= 3
 
 
+def test_lock_graph_covers_head_shards():
+    """The sharded head's locks are inside the static gate: the shard
+    lock is recognized through its _TimedRLock wrapper, and the one
+    sanctioned nesting (HeadServer._lock -> HeadShard._lock, the
+    named-actor name release) resolved into an edge — so a future
+    reverse edge (shard code calling back into the head under a shard
+    lock) would close a GC201 cycle and fail the suite."""
+    files = iter_py_files([os.path.join(REPO, "ray_tpu", "_private")])
+    graph = analyze_lock_order(files)
+    assert graph.lock_kinds.get(("HeadShard", "_lock")) == "rlock"
+    assert (("HeadServer", "_lock"), ("HeadShard", "_lock")) \
+        in graph.edges
+    assert not any(a[0] == "HeadShard" and b[0] == "HeadServer"
+                   for a, b in graph.edges)
+    assert graph.findings == [], [f.render() for f in graph.findings]
+
+
 # ---------------------------------------------------------------------
 # runtime lock tracer (RAY_TPU_LOCKCHECK=1)
 # ---------------------------------------------------------------------
